@@ -1,0 +1,28 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability-equivalent of PaddlePaddle Fluid ~1.2 (the reference at
+/root/reference), redesigned TPU-first on JAX/XLA/Pallas/pjit:
+
+- `paddle_tpu.nn` / `paddle_tpu.ops` — layer + op library (≈ fluid.layers,
+  paddle/fluid/operators/)
+- `paddle_tpu.core` — module system, executor, program export (≈
+  framework.py Program/Block + framework/executor.cc)
+- `paddle_tpu.optim` — optimizers, LR schedules, clipping (≈ optimizer.py)
+- `paddle_tpu.parallel` — mesh/sharding engine: DP, ZeRO, tensor, sequence
+  (ring attention) parallelism over ICI/DCN collectives (≈ ParallelExecutor,
+  DistributeTranspiler, NCCL/gRPC stack)
+- `paddle_tpu.data` — reader decorators, datasets, device prefetch (≈
+  paddle.reader, operators/reader/)
+- `paddle_tpu.io` — checkpointing and inference export (≈ fluid.io)
+- `paddle_tpu.metrics` — metric ops (≈ fluid.metrics, operators/metrics/)
+- `paddle_tpu.kernels` — Pallas TPU kernels (≈ operators/jit, fused ops)
+- `paddle_tpu.profiler` — tracing/timeline (≈ platform/profiler)
+"""
+
+from paddle_tpu.utils.flags import FLAGS, get_flags, set_flags
+from paddle_tpu.core.module import (
+    Context, Module, Sequential, Variables, named_params, param_count,
+)
+from paddle_tpu import nn, ops, optim
+
+__version__ = "0.1.0"

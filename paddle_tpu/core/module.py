@@ -1,0 +1,259 @@
+"""Functional module system — the framework's graph-construction layer.
+
+Capability-equivalent of the reference's Python graph builder
+(python/paddle/fluid/framework.py: Program:1678, Block:1008, Operator:562,
+Variable:240, Parameter:2311) plus LayerHelper (layer_helper.py). The
+reference builds a protobuf ProgramDesc that a C++ executor interprets; on
+TPU the XLA compiler *is* the executor, so the equivalent artifact is a pure
+function over a parameter pytree, traced once under `jax.jit`.
+
+Design:
+- A `Module` is a declarative spec (a Python object tree). It holds NO
+  tensors. Parameters/state live in a nested-dict pytree ("variables").
+- `module.init(rng, *inputs)` traces `forward` once with an init context,
+  materialising every `cx.param(...)`/`cx.state(...)` request → variables.
+- `module.apply(variables, *inputs, ...)` re-traces with a read context;
+  mutable state (e.g. BatchNorm running stats) is collected functionally and
+  returned as a new pytree — no in-place mutation, so everything is
+  jit/pjit/grad/vmap-safe.
+- Submodules auto-register via attribute assignment; a child invoked as
+  `self.child(cx, x)` scopes its variables under `"child"` in the tree.
+  Calling the same child twice shares weights (the reference's shared-param
+  capability, ParamAttr name reuse).
+
+This replaces an interpreted op-graph with what XLA wants: one big traced
+function with static shapes and no Python control flow at run time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+Variables = Dict[str, Any]  # {"params": {...}, "state": {...}}
+
+PARAMS = "params"
+STATE = "state"
+
+
+class ModuleError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class _CtxCore:
+    """Shared mutable core of a traversal: the variable trees + rng + mode."""
+    mode: str                      # "init" | "apply"
+    variables: Dict[str, Dict]     # collection -> nested dict
+    mutated: Dict[str, Dict]       # collections (re)written this traversal
+    rng: Optional[jax.Array]
+    rng_count: int
+    training: bool
+
+    def next_rng(self) -> jax.Array:
+        if self.rng is None:
+            raise ModuleError(
+                "An rng was requested (param init or dropout) but none was "
+                "provided. Pass `rngs=` to apply() or a seed to init().")
+        self.rng_count += 1
+        return jax.random.fold_in(self.rng, self.rng_count)
+
+
+def _tree_get(tree: Dict, path: Tuple[str, ...]) -> Any:
+    node = tree
+    for p in path:
+        if not isinstance(node, dict) or p not in node:
+            return None
+        node = node[p]
+    return node
+
+
+def _tree_set(tree: Dict, path: Tuple[str, ...], value: Any) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+class Context:
+    """Scoped view into a traversal. Cheap to fork per-submodule."""
+
+    __slots__ = ("_core", "path")
+
+    def __init__(self, core: _CtxCore, path: Tuple[str, ...] = ()):
+        self._core = core
+        self.path = path
+
+    # -- scoping ----------------------------------------------------------
+    def scope(self, name: str) -> "Context":
+        return Context(self._core, self.path + (name,))
+
+    @property
+    def training(self) -> bool:
+        return self._core.training
+
+    @property
+    def is_initializing(self) -> bool:
+        return self._core.mode == "init"
+
+    def rng(self) -> jax.Array:
+        return self._core.next_rng()
+
+    # -- variables --------------------------------------------------------
+    def param(self, name: str, shape: Sequence[int],
+              init: Callable[[jax.Array, Sequence[int], Any], jax.Array],
+              dtype: Any = jnp.float32) -> jax.Array:
+        """Get-or-create a trainable parameter at this scope."""
+        full = self.path + (name,)
+        core = self._core
+        existing = _tree_get(core.variables.get(PARAMS, {}), full)
+        if existing is not None:
+            if tuple(existing.shape) != tuple(shape):
+                raise ModuleError(
+                    f"param {'/'.join(full)}: shape {tuple(existing.shape)} "
+                    f"!= requested {tuple(shape)}")
+            return existing
+        if core.mode != "init":
+            raise ModuleError(
+                f"param {'/'.join(full)} missing from variables during apply()")
+        value = init(core.next_rng(), tuple(shape), dtype)
+        value = jnp.asarray(value, dtype)
+        _tree_set(core.variables.setdefault(PARAMS, {}), full, value)
+        return value
+
+    def state(self, name: str, shape: Sequence[int],
+              init: Callable[..., jax.Array],
+              dtype: Any = jnp.float32) -> jax.Array:
+        """Get-or-create non-trainable state (running stats, counters)."""
+        full = self.path + (name,)
+        core = self._core
+        # Mutations this traversal win over the input tree.
+        cur = _tree_get(core.mutated.get(STATE, {}), full)
+        if cur is None:
+            cur = _tree_get(core.variables.get(STATE, {}), full)
+        if cur is not None:
+            return cur
+        if core.mode != "init":
+            raise ModuleError(
+                f"state {'/'.join(full)} missing from variables during apply()")
+        value = jnp.asarray(init(None, tuple(shape), dtype), dtype)
+        _tree_set(core.variables.setdefault(STATE, {}), full, value)
+        return value
+
+    def set_state(self, name: str, value: jax.Array) -> None:
+        full = self.path + (name,)
+        _tree_set(self._core.mutated.setdefault(STATE, {}), full, value)
+
+
+class Module:
+    """Base class for all layers/models. Declarative; holds no tensors."""
+
+    def __init__(self):
+        object.__setattr__(self, "_children", {})
+        object.__setattr__(self, "_name", None)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Module):
+            self._children[name] = value
+            if value._name is None:
+                object.__setattr__(value, "_name", name)
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Module) for v in value):
+            # ModuleList capability: self.blocks = [Block() for ...]
+            for i, v in enumerate(value):
+                self._children[f"{name}_{i}"] = v
+                if v._name is None:
+                    object.__setattr__(v, "_name", f"{name}_{i}")
+        object.__setattr__(self, name, value)
+
+    # -- user API ---------------------------------------------------------
+    def forward(self, cx: Context, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, cx: Context, *args, **kwargs):
+        # init()/apply() call forward() directly, so the root adds no scope
+        # level; every child invocation scopes under its attribute name.
+        if not isinstance(cx, Context):
+            raise ModuleError(
+                f"{type(self).__name__} must be called with a Context as the "
+                "first argument (use .init()/.apply() at the top level)")
+        name = self._name or type(self).__name__
+        return self.forward(cx.scope(name), *args, **kwargs)
+
+    # -- functional transforms -------------------------------------------
+    def init(self, rng, *args, training: bool = False, **kwargs) -> Variables:
+        """Trace forward once; return the materialised variables pytree."""
+        if isinstance(rng, int):
+            rng = jax.random.key(rng)
+        core = _CtxCore(mode="init", variables={}, mutated={}, rng=rng,
+                        rng_count=0, training=training)
+        self.forward(Context(core), *args, **kwargs)
+        core.variables.setdefault(PARAMS, {})
+        return core.variables
+
+    def apply(self, variables: Variables, *args, training: bool = False,
+              rngs: Optional[jax.Array] = None, mutable: bool = False,
+              **kwargs):
+        """Run forward. Returns output, or (output, new_state) if mutable."""
+        core = _CtxCore(mode="apply", variables=variables, mutated={},
+                        rng=rngs, rng_count=0, training=training)
+        out = self.forward(Context(core), *args, **kwargs)
+        if mutable:
+            new_state = _merge_state(variables.get(STATE, {}),
+                                     core.mutated.get(STATE, {}))
+            return out, {STATE: new_state}
+        return out
+
+    # -- introspection ----------------------------------------------------
+    def children(self) -> Dict[str, "Module"]:
+        return dict(self._children)
+
+    def __repr__(self) -> str:
+        lines = [type(self).__name__ + "("]
+        for n, c in self._children.items():
+            body = repr(c).replace("\n", "\n  ")
+            lines.append(f"  {n}: {body}")
+        lines.append(")")
+        return "\n".join(lines) if self._children else type(self).__name__ + "()"
+
+
+def _merge_state(old: Dict, new: Dict) -> Dict:
+    if not isinstance(old, dict):
+        return new
+    out = dict(old)
+    for k, v in new.items():
+        out[k] = _merge_state(old.get(k, {}), v) if isinstance(v, dict) else v
+    return out
+
+
+# -- pytree utilities (capability analogs of Scope var queries) -----------
+
+def param_count(variables: Variables) -> int:
+    leaves = jax.tree_util.tree_leaves(variables.get(PARAMS, {}))
+    return sum(int(x.size) for x in leaves)
+
+
+def named_params(variables: Variables) -> List[Tuple[str, jax.Array]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(variables.get(PARAMS, {}))
+    out = []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        out.append((name, leaf))
+    return out
+
+
+class Sequential(Module):
+    """Chain of modules applied in order (reference: fluid.nets style)."""
+
+    def __init__(self, *layers: Module):
+        super().__init__()
+        self.layers = list(layers)
+
+    def forward(self, cx: Context, x, **kwargs):
+        for i, layer in enumerate(self.layers):
+            x = layer(cx, x)
+        return x

@@ -1,0 +1,6 @@
+from paddle_tpu.core.module import Module, Context, Sequential
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.layers import (
+    Linear, Conv2D, Conv2DTranspose, BatchNorm, LayerNorm, GroupNorm,
+    Dropout, Embedding, max_pool2d, avg_pool2d, global_avg_pool2d,
+)

@@ -1,0 +1,2 @@
+from paddle_tpu.ops.functional import *  # noqa: F401,F403
+from paddle_tpu.ops import functional

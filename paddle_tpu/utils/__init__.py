@@ -1,0 +1,2 @@
+from paddle_tpu.utils.flags import FLAGS
+from paddle_tpu.utils import log

@@ -1,0 +1,19 @@
+"""Test config: force an 8-device virtual CPU mesh so sharding/collective
+code paths are exercised without TPU hardware (the analog of the reference's
+multi-process-on-localhost dist tests, test_dist_base.py:213)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

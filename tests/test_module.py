@@ -1,0 +1,121 @@
+"""Module-system tests (≈ reference framework.py Program/Block unit tests,
+tests/unittests/test_program.py / test_operator_desc.py territory)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, ops
+
+
+class MLP(pt.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(32)
+        self.fc2 = nn.Linear(10)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, cx, x):
+        x = ops.relu(self.fc1(cx, x))
+        x = self.drop(cx, x)
+        return self.fc2(cx, x)
+
+
+def test_init_creates_params():
+    m = MLP()
+    x = jnp.ones((4, 16))
+    variables = m.init(0, x)
+    p = variables["params"]
+    assert p["fc1"]["weight"].shape == (16, 32)
+    assert p["fc1"]["bias"].shape == (32,)
+    assert p["fc2"]["weight"].shape == (32, 10)
+    assert pt.param_count(variables) == 16 * 32 + 32 + 32 * 10 + 10
+
+
+def test_apply_deterministic_eval():
+    m = MLP()
+    x = jnp.ones((4, 16))
+    variables = m.init(0, x)
+    y1 = m.apply(variables, x)
+    y2 = m.apply(variables, x)
+    assert y1.shape == (4, 10)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_dropout_training_uses_rng():
+    m = MLP()
+    x = jnp.ones((8, 16))
+    variables = m.init(0, x)
+    y1 = m.apply(variables, x, training=True, rngs=jax.random.key(1))
+    y2 = m.apply(variables, x, training=True, rngs=jax.random.key(2))
+    assert not np.allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_missing_param_raises():
+    m = MLP()
+    x = jnp.ones((4, 16))
+    with pytest.raises(Exception):
+        m.apply({"params": {}}, x)
+
+
+def test_apply_jits():
+    m = MLP()
+    x = jnp.ones((4, 16))
+    variables = m.init(0, x)
+    f = jax.jit(lambda v, x: m.apply(v, x))
+    y = f(variables, x)
+    assert y.shape == (4, 10)
+
+
+def test_weight_sharing_same_child_twice():
+    class Shared(pt.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, use_bias=False)
+
+        def forward(self, cx, x):
+            return self.fc(cx, self.fc(cx, x))
+
+    m = Shared()
+    x = jnp.ones((2, 16))
+    variables = m.init(0, x)
+    # only one weight materialised
+    assert list(variables["params"].keys()) == ["fc"]
+    w = variables["params"]["fc"]["weight"]
+    y = m.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w @ w), rtol=1e-5)
+
+
+def test_batchnorm_state_updates():
+    class Net(pt.Module):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm(momentum=0.5)
+
+        def forward(self, cx, x):
+            return self.bn(cx, x)
+
+    m = Net()
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8), jnp.float32) * 3 + 1
+    variables = m.init(0, x)
+    np.testing.assert_allclose(
+        np.asarray(variables["state"]["bn"]["mean"]), np.zeros(8))
+    y, updated = m.apply(variables, x, training=True, mutable=True)
+    # training output is normalised
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, 0)), np.zeros(8),
+                               atol=1e-5)
+    new_mean = np.asarray(updated["state"]["bn"]["mean"])
+    assert not np.allclose(new_mean, 0)
+    # eval mode uses running stats
+    variables2 = {"params": variables["params"], "state": updated["state"]}
+    y_eval = m.apply(variables2, x)
+    assert not np.allclose(np.asarray(y_eval), np.asarray(y))
+
+
+def test_sequential():
+    m = pt.Sequential(nn.Linear(8), nn.Linear(4))
+    x = jnp.ones((2, 6))
+    variables = m.init(0, x)
+    assert m.apply(variables, x).shape == (2, 4)
