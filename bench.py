@@ -1,0 +1,70 @@
+"""Benchmark entrypoint (driver contract: prints ONE JSON line).
+
+Metric: ResNet-50 training throughput, imgs/sec, batch 64, synthetic data —
+the reference's headline trainable-model metric (BASELINE.md: ResNet-50
+train, imgs/s, bs=64 = 81.69 on 2x Xeon E5-2650v4 via MKL-DNN; the modern
+harness benchmark/fluid/fluid_benchmark.py reports the same imgs/s metric).
+
+Runs on whatever jax.devices() provides (real TPU under the driver; CPU
+locally). Keeps compile out of the timed region.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_IMGS_PER_SEC = 81.69  # reference ResNet-50 train bs=64 (BASELINE.md)
+
+
+def main():
+    from paddle_tpu.core.executor import Trainer, supervised_loss
+    from paddle_tpu.metrics import accuracy
+    from paddle_tpu.models import resnet50
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Momentum
+
+    batch = 64
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # bf16 compute on TPU (MXU native), fp32 params.
+    model = resnet50(num_classes=1000,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    loss_fn = supervised_loss(
+        lambda logits, y: F.softmax_with_cross_entropy(
+            logits.astype(jnp.float32), y),
+        metrics={"acc": accuracy})
+    trainer = Trainer(model, Momentum(0.1, momentum=0.9), loss_fn)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 224, 224, 3).astype(np.float32)
+    y = rng.randint(0, 1000, size=batch).astype(np.int64)
+    x, y = jax.device_put(x), jax.device_put(y)
+
+    ts = trainer.init_state(x)
+    key = jax.random.key(0)
+
+    # warmup/compile
+    for _ in range(3):
+        ts, fetches = trainer.train_step(ts, (x, y), rng=key)
+    jax.block_until_ready(fetches["loss"])
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        ts, fetches = trainer.train_step(ts, (x, y), rng=key)
+    jax.block_until_ready(fetches["loss"])
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_bs64",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/s",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

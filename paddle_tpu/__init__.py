@@ -23,6 +23,24 @@ from paddle_tpu.utils.flags import FLAGS, get_flags, set_flags
 from paddle_tpu.core.module import (
     Context, Module, Sequential, Variables, named_params, param_count,
 )
+from paddle_tpu.core.executor import (
+    Executor, NaiveExecutor, Trainer, TrainState, supervised_loss,
+)
 from paddle_tpu import nn, ops, optim
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy subpackage access (data, io, metrics, models, parallel, ...) to
+    # keep base import light.
+    import importlib
+    if name in ("data", "io", "metrics", "models", "parallel", "kernels",
+                "profiler", "serving"):
+        try:
+            return importlib.import_module(f"paddle_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # keep the hasattr/getattr contract: AttributeError, not MNFE
+            raise AttributeError(
+                f"module paddle_tpu has no attribute {name}") from e
+    raise AttributeError(f"module paddle_tpu has no attribute {name}")

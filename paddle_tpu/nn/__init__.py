@@ -4,3 +4,6 @@ from paddle_tpu.nn.layers import (
     Linear, Conv2D, Conv2DTranspose, BatchNorm, LayerNorm, GroupNorm,
     Dropout, Embedding, max_pool2d, avg_pool2d, global_avg_pool2d,
 )
+from paddle_tpu.nn.rnn import (
+    BiRNN, GRUCell, LSTMCell, RNN, StackedLSTM,
+)

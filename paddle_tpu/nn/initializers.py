@@ -122,3 +122,19 @@ def numpy_array(arr) -> Any:
             raise ValueError(f"numpy_array init shape {a.shape} != {shape}")
         return a
     return init
+
+
+def orthogonal(scale: float = 1.0):
+    """Orthogonal init (RNN recurrent weights; standard practice the
+    reference reaches via numpy + NumpyArrayInitializer)."""
+    def init(rng, shape, dtype):
+        n_rows = shape[0]
+        n_cols = int(np.prod(shape[1:]))
+        mat = jax.random.normal(rng, (max(n_rows, n_cols),
+                                      min(n_rows, n_cols)), jnp.float32)
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if n_rows < n_cols:
+            q = q.T
+        return (scale * q[:n_rows, :n_cols]).reshape(shape).astype(dtype)
+    return init

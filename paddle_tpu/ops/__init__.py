@@ -1,2 +1,3 @@
 from paddle_tpu.ops.functional import *  # noqa: F401,F403
-from paddle_tpu.ops import functional
+from paddle_tpu.ops import functional, sequence
+from paddle_tpu.ops.beam_search import BeamResult, beam_search, tile_beams

@@ -63,9 +63,10 @@ def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
 
 
 def maxout(x, groups: int):
-    """operators/maxout_op: channel-last maxout."""
+    """operators/maxout_op: max over `groups` consecutive channels per
+    output channel (reference math/maxouting.cc layout)."""
     c = x.shape[-1]
-    return jnp.max(x.reshape(x.shape[:-1] + (groups, c // groups)), axis=-2)
+    return jnp.max(x.reshape(x.shape[:-1] + (c // groups, groups)), axis=-1)
 
 
 ACTIVATIONS = {
@@ -103,8 +104,8 @@ def cross_entropy(probs, label, soft_label: bool = False, axis: int = -1,
     logp = jnp.log(jnp.maximum(probs, epsilon))
     if soft_label:
         return -jnp.sum(label * logp, axis=axis)
-    return -jnp.take_along_axis(
-        logp, label[..., None].astype(jnp.int32), axis=axis)[..., 0]
+    idx = jnp.expand_dims(label.astype(jnp.int32), axis)
+    return -jnp.squeeze(jnp.take_along_axis(logp, idx, axis=axis), axis)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
@@ -117,7 +118,8 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
     label = label.astype(jnp.int32)
     valid = label != ignore_index
     safe = jnp.where(valid, label, 0)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=axis)[..., 0]
+    nll = -jnp.squeeze(jnp.take_along_axis(
+        logp, jnp.expand_dims(safe, axis), axis=axis), axis)
     return jnp.where(valid, nll, 0.0)
 
 
@@ -274,7 +276,11 @@ def reshape(x, shape):
 
 
 def squeeze(x, axes=None):
-    return jnp.squeeze(x, axis=tuple(axes) if axes else None)
+    if axes is None:
+        return jnp.squeeze(x)
+    if isinstance(axes, int):
+        axes = (axes,)
+    return jnp.squeeze(x, axis=tuple(axes))
 
 
 def unsqueeze(x, axes):
@@ -321,7 +327,7 @@ def cumsum(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
 def shard_index(ids, index_num: int, nshards: int, shard_id: int,
                 ignore_value: int = -1):
     """operators/shard_index_op: map global ids to shard-local or ignore."""
-    shard_size = index_num // nshards
+    shard_size = (index_num + nshards - 1) // nshards
     in_shard = (ids // shard_size) == shard_id
     return jnp.where(in_shard, ids % shard_size, ignore_value)
 
@@ -355,5 +361,24 @@ def resize_nearest(x, out_shape):
 
 
 def resize_bilinear(x, out_shape, align_corners: bool = False):
-    return jax.image.resize(
-        x, (x.shape[0],) + tuple(out_shape) + (x.shape[3],), "bilinear")
+    """operators/interpolate_op bilinear. align_corners=True samples the
+    corner-aligned grid (the fluid default); False = half-pixel
+    (jax.image.resize semantics)."""
+    oh, ow = out_shape
+    if not align_corners:
+        return jax.image.resize(
+            x, (x.shape[0], oh, ow, x.shape[3]), "bilinear")
+    h, w = x.shape[1], x.shape[2]
+    ys = (jnp.linspace(0.0, h - 1.0, oh) if oh > 1
+          else jnp.zeros((1,)))
+    xs = (jnp.linspace(0.0, w - 1.0, ow) if ow > 1
+          else jnp.zeros((1,)))
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy

@@ -1,0 +1,96 @@
+"""Ring attention: sequence/context parallelism over the mesh "sp" axis.
+
+This is the TPU-native long-context capability the reference lacks
+(SURVEY.md §5.7 flags it as the north-star extension: the reference's
+long-sequence story is LoD ragged batching only). Design follows the
+ring-attention pattern: shard the sequence axis across devices; Q stays
+resident; K/V blocks rotate around the ring via `ppermute` over ICI while
+each device accumulates online-softmax partial results — full attention
+semantics with O(T/sp) memory per device and compute/communication overlap.
+
+Built on shard_map + lax.ppermute (the same collectives the reference's
+NCCL op-handles map to, §5.8) — no custom comm backend needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, causal, q_block_idx, k_block_idx,
+                  block_len):
+    """Partial attention of local q against one rotating k/v block.
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]. Returns (m, l, acc) pieces.
+    Global positions: q_pos = q_block_idx*block_len + i, likewise k."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = q_block_idx * block_len + jnp.arange(tq)
+        kpos = k_block_idx * block_len + jnp.arange(tk)
+        mask = kpos[None, :] <= qpos[:, None]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)              # [B,H,Tq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return m, l, acc
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   scale: Optional[float] = None, causal: bool = False):
+    """Full attention over sequence sharded on `axis`.
+
+    q/k/v: global [B, T, H, D] arrays (sharded or shardable on T). Returns
+    [B, T, H, D] with the same sharding. Must be called under jit (it uses
+    shard_map internally).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    sp = mesh.shape[axis]
+    spec = P(None, axis, None, None)
+
+    def local_fn(q_l, k_l, v_l):
+        # q_l/k_l/v_l: [B, T/sp, H, D] local shards
+        my = lax.axis_index(axis)
+        block_len = q_l.shape[1]
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def body(step, carry):
+            k_cur, v_cur, m, l, acc = carry
+            # the block currently held arrived from (my - step) mod sp
+            k_idx = (my - step) % sp
+            bm, bl, bacc = _block_attend(q_l, k_cur, v_cur, scale, causal,
+                                         my, k_idx, block_len)
+            # online-softmax merge of (m,l,acc) with block partials
+            m_new = jnp.maximum(m, bm)
+            c1 = jnp.exp(m - m_new)
+            c2 = jnp.exp(bm - m_new)
+            l_new = l * c1 + bl * c2
+            # acc layout [B,Tq,H,D]; coefficients are [B,H,Tq,1]
+            def fix(c):
+                return jnp.transpose(c, (0, 2, 1, 3))   # -> [B,Tq,H,1]
+            acc_new = acc * fix(c1).astype(acc.dtype) \
+                + bacc * fix(c2).astype(acc.dtype)
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            return k_nxt, v_nxt, m_new, l_new, acc_new
+
+        b, tq, h, _ = q_l.shape
+        m0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+        a0 = jnp.zeros_like(q_l, shape=(b, tq, h, d))
+        _, _, m, l, acc = lax.fori_loop(
+            0, sp, body, (k_l, v_l, m0, l0, a0))
+        denom = jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1, 3))
+        return (acc / denom.astype(acc.dtype)).astype(q_l.dtype)
+
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

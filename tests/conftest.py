@@ -4,11 +4,18 @@ multi-process-on-localhost dist tests, test_dist_base.py:213)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU even if the ambient environment points JAX at a TPU: the suite
+# needs 8 virtual devices. Set PTPU_TEST_REAL_DEVICE=1 to opt out.
+# The environment may have imported jax already (sitecustomize TPU hook), so
+# setting os.environ is not enough — update jax.config directly.
+if not os.environ.get("PTPU_TEST_REAL_DEVICE"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

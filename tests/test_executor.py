@@ -1,0 +1,85 @@
+"""Executor/Trainer tests (≈ reference executor tests + book train loops)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.executor import (
+    Executor, NaiveExecutor, Trainer, TrainState, supervised_loss)
+from paddle_tpu.metrics import accuracy
+from paddle_tpu.models import MLP
+from paddle_tpu.ops import functional as F
+from paddle_tpu.optim.optimizer import Adam, SGD
+
+
+def test_executor_run_feed_fetch():
+    exe = Executor()
+
+    def program(x, y):
+        return {"sum": x + y, "prod": x * y}
+
+    out = exe.run(program, feed={"x": np.ones(4), "y": np.full(4, 2.0)},
+                  fetch_list=["sum", "prod"])
+    np.testing.assert_allclose(out[0], 3.0 * np.ones(4))
+    np.testing.assert_allclose(out[1], 2.0 * np.ones(4))
+    # program cache: same signature → no new compile
+    exe.run(program, feed={"x": np.zeros(4), "y": np.zeros(4)})
+    assert exe.cache_misses == 1
+
+
+def test_naive_executor():
+    nex = NaiveExecutor(lambda x: x * 2, [jnp.ones((2, 2))])
+    np.testing.assert_allclose(nex.run(jnp.ones((2, 2))), 2.0)
+
+
+def _make_trainer(seed=0):
+    model = MLP(hidden=(32,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda logits, y: F.softmax_with_cross_entropy(logits, y),
+        metrics={"acc": accuracy})
+    return Trainer(model, Adam(1e-2), loss_fn, seed=seed)
+
+
+def _batches(n, bs=16, dim=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, classes)
+    for _ in range(n):
+        x = rng.randn(bs, dim).astype(np.float32)
+        y = np.argmax(x @ w + 0.1 * rng.randn(bs, classes), -1)
+        yield x, y.astype(np.int64)
+
+
+def test_trainer_learns():
+    trainer = _make_trainer()
+    ts = trainer.init_state(jnp.zeros((16, 8)))
+    first_loss = None
+    for batch in _batches(60):
+        ts, fetches = trainer.train_step(ts, batch)
+        if first_loss is None:
+            first_loss = float(fetches["loss"])
+    assert int(ts.step) == 60
+    assert float(fetches["loss"]) < first_loss * 0.7
+    ev = trainer.eval_step(ts, next(iter(_batches(1, seed=9))))
+    assert 0.0 <= float(ev["acc"]) <= 1.0
+
+
+def test_train_state_is_pytree():
+    trainer = _make_trainer()
+    ts = trainer.init_state(jnp.zeros((4, 8)))
+    leaves = jax.tree_util.tree_leaves(ts)
+    assert all(hasattr(l, "shape") for l in leaves)
+    ts2 = jax.tree.map(lambda x: x, ts)
+    assert isinstance(ts2, TrainState)
+
+
+def test_nan_guard():
+    pt.FLAGS.set("check_nan_inf", True)
+    try:
+        exe = Executor()
+        with pytest.raises(FloatingPointError):
+            exe.run(lambda x: {"y": jnp.log(x)},
+                    feed={"x": np.array([-1.0])}, fetch_list=["y"])
+    finally:
+        pt.FLAGS.set("check_nan_inf", False)

@@ -1,0 +1,79 @@
+"""Checkpoint + inference export tests (≈ fluid.io save/load tests,
+tests/book save_inference_model round-trips)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.executor import Trainer, supervised_loss
+from paddle_tpu.io import (
+    CheckpointManager, InferencePredictor, latest_checkpoint, load_checkpoint,
+    load_inference_model, save_checkpoint, save_inference_model)
+from paddle_tpu.models import MLP
+from paddle_tpu.ops import functional as F
+from paddle_tpu.optim.optimizer import SGD
+
+
+def _trainer():
+    loss_fn = supervised_loss(
+        lambda logits, y: F.softmax_with_cross_entropy(logits, y))
+    return Trainer(MLP(hidden=(16,), num_classes=3), SGD(0.1), loss_fn)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    path = save_checkpoint(str(tmp_path / "ck"), ts, step=0)
+    restored = load_checkpoint(path, target=ts)
+    for a, b in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(a, b)
+
+
+def test_checkpoint_shape_mismatch(tmp_path):
+    save_checkpoint(str(tmp_path / "ck"), {"w": np.zeros((2, 2))})
+    with pytest.raises(ValueError):
+        load_checkpoint(str(tmp_path / "ck"), target={"w": np.zeros((3,))})
+
+
+def test_checkpoint_missing_leaf(tmp_path):
+    save_checkpoint(str(tmp_path / "ck"), {"w": np.zeros(2)})
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "ck"),
+                        target={"w": np.zeros(2), "b": np.zeros(1)})
+
+
+def test_manager_rotation_and_resume(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2)
+    tree = {"w": np.arange(3.0)}
+    for step in (1, 2, 3):
+        mgr.save({"w": tree["w"] * step}, step=step)
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["ckpt-2", "ckpt-3"]
+    restored, step = mgr.restore_latest(target=tree)
+    assert step == 3
+    np.testing.assert_allclose(restored["w"], tree["w"] * 3)
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt-3")
+
+
+def test_inference_export_roundtrip(tmp_path):
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    model_dir = str(tmp_path / "model")
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    save_inference_model(model_dir, trainer.module, ts.variables, [x],
+                         input_names=["x"])
+
+    fn, variables, sig = load_inference_model(model_dir)
+    assert sig["input_names"] == ["x"]
+    expected = trainer.module.apply(ts.variables, x)
+    got = fn(variables, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)
+
+    pred = InferencePredictor(model_dir)
+    out = pred.run({"x": np.asarray(x)})
+    np.testing.assert_allclose(out[0], np.asarray(expected),
+                               rtol=1e-5, atol=1e-5)

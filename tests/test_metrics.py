@@ -1,0 +1,66 @@
+"""Metric tests (≈ operators/metrics/*_op tests + fluid metrics.py tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import metrics as M
+
+
+def test_accuracy_top1_topk():
+    logits = jnp.asarray([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1],
+                          [0.2, 0.3, 0.5], [0.9, 0.05, 0.05]])
+    labels = jnp.asarray([1, 0, 0, 0])
+    assert float(M.accuracy(logits, labels)) == 0.75
+    # top-2: row [0.2,0.3,0.5] (label 0) still misses; others hit
+    assert float(M.accuracy(logits, labels, k=2)) == 0.75
+    assert float(M.accuracy(logits, labels, k=3)) == 1.0
+
+
+def test_auc_in_graph_perfect_separation():
+    probs = jnp.asarray([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+    labels = jnp.asarray([0, 0, 1, 1])
+    assert float(M.auc(probs, labels)) > 0.95
+
+
+def test_streaming_accuracy():
+    acc = M.Accuracy()
+    acc.update(0.5, weight=10)
+    acc.update(1.0, weight=10)
+    assert abs(acc.eval() - 0.75) < 1e-9
+
+
+def test_precision_recall():
+    p, r = M.Precision(), M.Recall()
+    preds = np.array([1, 1, 0, 1, 0])
+    labels = np.array([1, 0, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.eval() - 2 / 3) < 1e-9
+    assert abs(r.eval() - 2 / 3) < 1e-9
+
+
+def test_streaming_auc():
+    auc = M.Auc(num_thresholds=1023)
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, 1000)
+    # well-separated scores → high auc
+    scores = np.where(labels, 0.7, 0.3) + rng.randn(1000) * 0.1
+    auc.update(np.clip(scores, 0, 1), labels)
+    assert auc.eval() > 0.9
+
+
+def test_edit_distance():
+    ed = M.EditDistance()
+    ed.update([[1, 2, 3]], [[1, 2, 3]])
+    ed.update([[1, 2]], [[1, 2, 3, 4]])
+    avg, exact = ed.eval()
+    assert abs(avg - 0.25) < 1e-9
+    assert abs(exact - 0.5) < 1e-9
+
+
+def test_chunk_evaluator():
+    ch = M.ChunkEvaluator()
+    ch.update(10, 8, 6)
+    p, r, f1 = ch.eval()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    assert abs(f1 - 2 * 0.6 * 0.75 / 1.35) < 1e-9

@@ -1,0 +1,142 @@
+"""Sequence-op tests vs numpy references (≈ tests/unittests/
+test_sequence_*.py: OpTest pattern — compute with ragged numpy loops,
+compare against the vectorised TPU formulation)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import sequence as S
+
+
+@pytest.fixture
+def ragged_batch(rng):
+    b, t, d = 4, 7, 3
+    x = rng.randn(b, t, d).astype(np.float32)
+    lengths = np.array([7, 3, 5, 1])
+    for i, l in enumerate(lengths):
+        x[i, l:] = 0.0
+    return x, lengths
+
+
+def test_sequence_mask():
+    m = np.asarray(S.sequence_mask(jnp.asarray([2, 0, 3]), 4))
+    expected = np.array([[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]], bool)
+    np.testing.assert_array_equal(m, expected)
+
+
+def test_sequence_pool_all_types(ragged_batch):
+    x, lengths = ragged_batch
+    xl, ll = jnp.asarray(x), jnp.asarray(lengths)
+    for pool in ("sum", "mean", "sqrt", "max", "first", "last"):
+        out = np.asarray(S.sequence_pool(xl, ll, pool))
+        for i, l in enumerate(lengths):
+            seq = x[i, :l] if l else np.zeros((1, x.shape[2]), np.float32)
+            if pool == "sum":
+                ref = seq.sum(0) if l else np.zeros(x.shape[2])
+            elif pool == "mean":
+                ref = seq.mean(0) if l else np.zeros(x.shape[2])
+            elif pool == "sqrt":
+                ref = seq.sum(0) / np.sqrt(max(l, 1))
+            elif pool == "max":
+                ref = seq.max(0) if l else np.full(x.shape[2], -1e9)
+            elif pool == "first":
+                ref = x[i, 0]
+            else:
+                ref = x[i, max(l - 1, 0)]
+            np.testing.assert_allclose(out[i], ref, rtol=1e-5, atol=1e-5,
+                                       err_msg=f"{pool} row {i}")
+
+
+def test_pack_pad_roundtrip(ragged_batch):
+    x, lengths = ragged_batch
+    r = S.pack_padded(jnp.asarray(x), jnp.asarray(lengths))
+    padded, mask = S.pad_packed(r, x.shape[1])
+    np.testing.assert_allclose(np.asarray(padded), x, rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(mask), np.asarray(S.sequence_mask(jnp.asarray(lengths),
+                                                     x.shape[1])))
+
+
+def test_segment_pool_matches_sequence_pool(ragged_batch):
+    x, lengths = ragged_batch
+    r = S.pack_padded(jnp.asarray(x), jnp.asarray(lengths))
+    for pool in ("sum", "mean"):
+        a = np.asarray(S.segment_pool(r, pool))
+        b = np.asarray(S.sequence_pool(jnp.asarray(x), jnp.asarray(lengths),
+                                       pool))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax(ragged_batch):
+    x, lengths = ragged_batch
+    out = np.asarray(S.sequence_softmax(jnp.asarray(x[..., 0]),
+                                        jnp.asarray(lengths)))
+    for i, l in enumerate(lengths):
+        if l:
+            e = np.exp(x[i, :l, 0] - x[i, :l, 0].max())
+            np.testing.assert_allclose(out[i, :l], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[i, l:], 0.0, atol=1e-6)
+
+
+def test_sequence_reverse(ragged_batch):
+    x, lengths = ragged_batch
+    out = np.asarray(S.sequence_reverse(jnp.asarray(x), jnp.asarray(lengths)))
+    for i, l in enumerate(lengths):
+        np.testing.assert_allclose(out[i, :l], x[i, :l][::-1], rtol=1e-6)
+        np.testing.assert_allclose(out[i, l:], x[i, l:], rtol=1e-6)
+
+
+def test_sequence_concat():
+    a = jnp.asarray(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+    b = jnp.asarray(100 + np.arange(8, dtype=np.float32).reshape(2, 2, 2))
+    la, lb = jnp.asarray([3, 1]), jnp.asarray([2, 2])
+    out, lens = S.sequence_concat([a, b], [la, lb], maxlen=5)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(np.asarray(lens), [5, 3])
+    np.testing.assert_allclose(out[0, :3], np.asarray(a[0]))
+    np.testing.assert_allclose(out[0, 3:5], np.asarray(b[0]))
+    np.testing.assert_allclose(out[1, 0], np.asarray(a[1, 0]))
+    np.testing.assert_allclose(out[1, 1:3], np.asarray(b[1]))
+
+
+def test_sequence_erase():
+    toks = jnp.asarray([[1, 2, 3, 2, 5], [2, 2, 2, 0, 0]])
+    lens = jnp.asarray([5, 3])
+    out, nl = S.sequence_erase(toks, lens, [2])
+    np.testing.assert_array_equal(np.asarray(nl), [3, 0])
+    np.testing.assert_array_equal(np.asarray(out[0, :3]), [1, 3, 5])
+    np.testing.assert_array_equal(np.asarray(out[1]), [0, 0, 0, 0, 0])
+
+
+def test_sequence_enumerate():
+    toks = jnp.asarray([[1, 2, 3, 4, 0]])
+    lens = jnp.asarray([4])
+    out = np.asarray(S.sequence_enumerate(toks, lens, 2, pad_value=9))
+    np.testing.assert_array_equal(out[0, 0], [1, 2])
+    np.testing.assert_array_equal(out[0, 2], [3, 4])
+    np.testing.assert_array_equal(out[0, 3], [4, 9])
+    np.testing.assert_array_equal(out[0, 4], [9, 9])
+
+
+def test_sequence_conv_masks_padding(ragged_batch, rng):
+    x, lengths = ragged_batch
+    d, out_d, ctx = x.shape[2], 5, 3
+    w = rng.randn(ctx * d, out_d).astype(np.float32)
+    out = np.asarray(S.sequence_conv(jnp.asarray(x), jnp.asarray(lengths),
+                                     jnp.asarray(w), context_size=ctx))
+    assert out.shape == (x.shape[0], x.shape[1], out_d)
+    for i, l in enumerate(lengths):
+        np.testing.assert_allclose(out[i, l:], 0.0, atol=1e-6)
+    # middle position of row 0 = full window
+    i, t = 0, 3
+    window = np.concatenate([x[i, t - 1], x[i, t], x[i, t + 1]])
+    np.testing.assert_allclose(out[i, t], window @ w, rtol=1e-4, atol=1e-5)
+
+
+def test_shrink_memory():
+    state = jnp.ones((3, 4))
+    out = np.asarray(S.shrink_memory(state, 2, jnp.asarray([5, 1, 3])))
+    np.testing.assert_allclose(out[0], 1.0)
+    np.testing.assert_allclose(out[1], 0.0)
+    np.testing.assert_allclose(out[2], 1.0)
