@@ -64,6 +64,11 @@ def save_inference_model(path: str, module_or_fn, variables: Variables,
         fn = module_or_fn
 
     variables = _prune_empty(variables)
+    # Gather to host first: training variables may be mesh-sharded, and
+    # jax.export would bake the training device count into the artifact —
+    # a served model must load on any topology (≈ the reference's pruned
+    # inference ProgramDesc being executor-agnostic, io.py:859).
+    variables = jax.tree.map(np.asarray, variables)
     example_inputs = tuple(jnp.asarray(x) for x in example_inputs)
     exported = jax.export.export(jax.jit(fn))(variables, *example_inputs)
     blob = exported.serialize()

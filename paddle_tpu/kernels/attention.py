@@ -60,19 +60,36 @@ def _on_tpu() -> bool:
 
 
 def mha(q, k, v, mask=None, scale: Optional[float] = None,
-        dropout_rng=None, dropout_rate: float = 0.0, causal: bool = False):
-    """Dispatching multi-head attention entry point used by model code."""
-    if causal and mask is None:
-        t_q, t_k = q.shape[1], k.shape[1]
-        mask = (jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]
-                )[None, None]
+        dropout_rng=None, dropout_rate: float = 0.0, causal: bool = False,
+        kv_len: Optional[int] = None):
+    """Dispatching multi-head attention entry point used by model code.
+
+    `causal` and `kv_len` (static right-padding length) are forwarded to the
+    flash kernel, which handles them block-wise — materializing them into a
+    dense `mask` would force the XLA reference path. An explicit `mask`
+    (arbitrary pattern) always uses the reference path.
+    """
+    # The kernel pads ragged sequence lengths to block multiples itself, so
+    # the gate only excludes: tiny sequences (kernel launch not worth it),
+    # head dims the MXU tiles badly, dropout, and arbitrary dense masks.
     use_flash = (FLAGS.get("flash_attention") and _on_tpu()
+                 and mask is None
                  and dropout_rate == 0.0
-                 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-                 and q.shape[-1] in (64, 128, 256))
+                 and q.shape[1] >= 64 and k.shape[1] >= 64
+                 and q.shape[-1] % 32 == 0 and q.shape[-1] <= 256)
     if use_flash:
         from paddle_tpu.kernels import flash
-        return flash.flash_attention(q, k, v, mask=mask, scale=scale)
+        return flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                     kv_len=kv_len)
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        cmask = (jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]
+                 )[None, None]
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+    if kv_len is not None:
+        t_k = k.shape[1]
+        pmask = (jnp.arange(t_k) < kv_len)[None, None, None, :]
+        mask = pmask if mask is None else jnp.logical_and(mask, pmask)
     return reference_attention(q, k, v, mask=mask, scale=scale,
                                dropout_rng=dropout_rng,
                                dropout_rate=dropout_rate)

@@ -65,10 +65,12 @@ class MultiHeadAttention(Module):
         b, t, _ = x.shape
         return x.reshape(b, t, self.num_heads, self.head_dim)
 
-    def forward(self, cx: Context, q, kv=None, mask=None,
+    def forward(self, cx: Context, q, kv=None, mask=None, causal=False,
                 cache: Optional[Dict] = None, decode_pos=None):
         """q: [B, Tq, D]; kv: [B, Tk, D] (None = self-attention).
         mask: broadcastable to [B, heads, Tq, Tk], True = attend.
+        causal: block-wise causal masking — forwarded to the flash kernel
+        (a dense causal mask would force the XLA reference path).
         cache: {"k","v"} [B, Tmax, H, Hd] updated at decode_pos."""
         kv_in = q if kv is None else kv
         qh = self._split(self.q_proj(cx, q))
@@ -85,7 +87,7 @@ class MultiHeadAttention(Module):
             kh, vh = k_all, v_all
 
         from paddle_tpu.kernels import attention as attn_kernel
-        out = attn_kernel.mha(qh, kh, vh, mask=mask,
+        out = attn_kernel.mha(qh, kh, vh, mask=mask, causal=causal,
                               dropout_rng=(cx.rng() if cx.training and
                                            self.drop.rate > 0 else None),
                               dropout_rate=(self.drop.rate if cx.training
@@ -140,8 +142,10 @@ class DecoderLayer(Module):
         self.drop = Dropout(dropout)
 
     def forward(self, cx: Context, x, memory, self_mask=None,
-                cross_mask=None, cache=None, decode_pos=None):
+                self_causal=False, cross_mask=None, cache=None,
+                decode_pos=None):
         h, new_cache = self.self_attn(cx, self.ln1(cx, x), mask=self_mask,
+                                      causal=self_causal,
                                       cache=cache, decode_pos=decode_pos)
         x = x + self.drop(cx, h)
         h, _ = self.cross_attn(cx, self.ln2(cx, x), kv=memory,
@@ -149,10 +153,6 @@ class DecoderLayer(Module):
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln3(cx, x)))
         return x, new_cache
-
-
-def causal_mask(t: int) -> jnp.ndarray:
-    return jnp.tril(jnp.ones((t, t), jnp.bool_))[None, None]
 
 
 class Transformer(Module):
@@ -199,9 +199,9 @@ class Transformer(Module):
         x = self.trg_embed(cx, trg_tokens) * math.sqrt(self.model_dim)
         x = x + sinusoid_position_encoding(t, self.model_dim).astype(x.dtype)
         x = self.drop(cx, x)
-        smask = causal_mask(t)
         for layer in self.dec_layers:
-            x, _ = layer(cx, x, memory, self_mask=smask, cross_mask=src_mask)
+            x, _ = layer(cx, x, memory, self_causal=True,
+                         cross_mask=src_mask)
         return self.head(cx, self.dec_ln(cx, x))
 
     def forward(self, cx: Context, src_tokens, trg_tokens, src_lengths=None):

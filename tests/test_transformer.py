@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.models.transformer import Transformer, causal_mask
+from paddle_tpu.models.transformer import Transformer
 from paddle_tpu.ops.beam_search import beam_search, tile_beams
 from paddle_tpu.kernels.attention import reference_attention
 
