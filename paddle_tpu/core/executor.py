@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import inspect
 import time
+import weakref
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
@@ -31,6 +33,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.module import Module, Variables, PARAMS, STATE
 from paddle_tpu.optim.optimizer import Optimizer
+from paddle_tpu.profiler.profiler import RecordEvent
 from paddle_tpu.utils.flags import FLAGS
 
 Pytree = Any
@@ -110,6 +113,11 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self.compile_count = 0
+        # Host-side step counter: lets the default-rng path fold in the step
+        # number without a device round-trip on ts.step every iteration.
+        # Seeded lazily from ts.step (one sync) so resumed runs continue the
+        # rng stream instead of replaying it from 0.
+        self._host_step: Optional[int] = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, *example_inputs, rng: Optional[jax.Array] = None
@@ -162,10 +170,14 @@ class Trainer:
                    ) -> Tuple[TrainState, Dict]:
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        if self._host_step is None:
+            self._host_step = int(jax.device_get(ts.step))
         if rng is None:
             rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
-                                     int(ts.step))
-        new_ts, fetches = self._train_step(ts, batch, rng)
+                                     self._host_step)
+        self._host_step += 1
+        with RecordEvent("Trainer.train_step"):
+            new_ts, fetches = self._train_step(ts, batch, rng)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
             check_nan_inf(new_ts.params, "params")
@@ -181,11 +193,15 @@ class Trainer:
             callback: Optional[Callable[[int, Dict], None]] = None
             ) -> TrainState:
         """Simple epoch loop (≈ tests/book training loops)."""
+        # One sync up front so resumed runs report true global steps; the
+        # steady-state loop then stays free of device round-trips.
+        self._host_step = int(jax.device_get(ts.step))
         step_t0, bench = time.perf_counter(), FLAGS.get("benchmark")
         for epoch in range(epochs):
             for batch in data:
                 ts, fetches = self.train_step(ts, batch)
-                s = int(ts.step)
+                # host-side counter: no device sync in the steady-state loop
+                s = self._host_step
                 if callback is not None:
                     callback(s, fetches)
                 if bench and log_every and s % log_every == 0:
@@ -233,14 +249,39 @@ class Executor:
 
     def __init__(self, place: Optional[Any] = None):
         self.place = place or jax.devices()[0]
-        self._cache: Dict[Any, Callable] = {}
+        # Keyed on the program object itself (not id()): a WeakKeyDictionary
+        # entry dies with its function, so a recycled id can never be served
+        # a stale executable. Inner dict is keyed by the feed signature.
+        self._cache: "weakref.WeakKeyDictionary[Callable, Dict[Tuple, Callable]]" = (
+            weakref.WeakKeyDictionary())
+        # Strong-ref fallback for callables that don't support weakrefs:
+        # keeping the object alive means its identity can never be
+        # recycled, so the cache stays sound.
+        self._strong_cache: Dict[Callable, Dict[Tuple, Callable]] = {}
         self.cache_misses = 0
 
-    def _signature(self, fn: Callable, feed: Dict[str, Any]) -> Tuple:
-        sig = [id(fn)]
+    def _cache_bucket(self, program: Callable) -> Dict[Tuple, Callable]:
+        # Bound methods are ephemeral objects (a fresh one per attribute
+        # access) — keying on them would evict every entry immediately.
+        # Key on the stable underlying function, scoped per instance via a
+        # weakly-referenced bucket on the instance's entry.
+        if inspect.ismethod(program):
+            try:
+                inst_buckets = self._cache.setdefault(program.__self__, {})
+            except TypeError:
+                inst_buckets = self._strong_cache.setdefault(
+                    program.__self__, {})
+            return inst_buckets.setdefault(program.__func__, {})
+        try:
+            return self._cache.setdefault(program, {})
+        except TypeError:
+            return self._strong_cache.setdefault(program, {})
+
+    @staticmethod
+    def _signature(feed: Dict[str, Any]) -> Tuple:
+        sig = []
         for k in sorted(feed):
-            v = feed[k]
-            arr = jnp.asarray(v)
+            arr = jnp.asarray(feed[k])
             sig.append((k, arr.shape, str(arr.dtype)))
         return tuple(sig)
 
@@ -249,11 +290,13 @@ class Executor:
         """program(**feed) -> dict of outputs; returns [outputs[k] for k in
         fetch_list] as numpy-convertible arrays (or the full dict)."""
         feed = feed or {}
-        key = self._signature(program, feed)
-        if key not in self._cache:
-            self._cache[key] = jax.jit(program)
+        key = self._signature(feed)
+        per_fn = self._cache_bucket(program)
+        if key not in per_fn:
+            per_fn[key] = jax.jit(program)
             self.cache_misses += 1
-        out = self._cache[key](**{k: jnp.asarray(v) for k, v in feed.items()})
+        with RecordEvent("Executor.run"):
+            out = per_fn[key](**{k: jnp.asarray(v) for k, v in feed.items()})
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(out, "program outputs")
         if fetch_list is None:
@@ -268,6 +311,7 @@ class Executor:
 
     def close(self) -> None:
         self._cache.clear()
+        self._strong_cache.clear()
 
 
 class NaiveExecutor:

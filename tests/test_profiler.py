@@ -1,0 +1,108 @@
+"""Profiler / timeline tests (≈ reference test_profiler.py over
+EnableProfiler/DisableProfiler + tools/timeline.py merge)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.profiler as prof
+
+
+def test_record_event_table():
+    prof.start_profiler()
+    for _ in range(3):
+        with prof.RecordEvent("work"):
+            np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    with prof.RecordEvent("other"):
+        pass
+    rows = prof.stop_profiler(sorted_key="total", print_table=False)
+    by_name = {r["name"]: r for r in rows}
+    assert by_name["work"]["calls"] == 3
+    assert by_name["other"]["calls"] == 1
+    assert rows[0]["name"] == "work"  # sorted by total time
+    assert abs(sum(r["ratio"] for r in rows) - 1.0) < 1e-6
+
+
+def test_disabled_records_nothing():
+    prof.reset_profiler()
+    with prof.RecordEvent("ignored"):
+        pass
+    assert prof.get_events() == []
+
+
+def test_profiler_context_and_chrome_trace(tmp_path):
+    path = str(tmp_path / "prof.json")
+    with prof.profiler(profile_path=path):
+        with prof.RecordEvent("span"):
+            pass
+    trace = json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert "span" in names
+
+
+def test_record_function_decorator():
+    @prof.record_function("decorated")
+    def f(x):
+        return x + 1
+
+    prof.start_profiler()
+    assert f(1) == 2
+    rows = prof.stop_profiler(print_table=False)
+    assert any(r["name"] == "decorated" for r in rows)
+
+
+def test_train_step_instrumented():
+    from paddle_tpu.core.executor import Trainer, supervised_loss
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import SGD
+
+    model = MLP(hidden=(8,), num_classes=4)
+    trainer = Trainer(model, SGD(0.1), supervised_loss(
+        lambda o, y: F.softmax_with_cross_entropy(o, y)))
+    x = jnp.ones((4, 8))
+    y = jnp.zeros((4,), jnp.int32)
+    ts = trainer.init_state(x)
+    prof.start_profiler()
+    ts, _ = trainer.train_step(ts, (x, y))
+    rows = prof.stop_profiler(print_table=False)
+    assert any(r["name"] == "Trainer.train_step" for r in rows)
+
+
+def test_timeline_merge(tmp_path):
+    p1, p2 = str(tmp_path / "t1.json"), str(tmp_path / "t2.json")
+    for path, name in [(p1, "a"), (p2, "b")]:
+        prof.start_profiler()
+        with prof.RecordEvent(name):
+            pass
+        prof.stop_profiler(profile_path=path, print_table=False)
+    out = str(tmp_path / "merged.json")
+    trace = prof.merge_profiles({"trainer1": p1, "trainer2": p2}, out)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {0, 1}
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} == {"a", "b"}
+    assert json.load(open(out)) == trace
+
+
+def test_annotate_device_trace(tmp_path):
+    # annotate must work inside a live computation (TraceAnnotation path)
+    with prof.annotate("matmul_region"):
+        out = jnp.dot(jnp.ones((16, 16)), jnp.ones((16, 16)))
+        jax.block_until_ready(out)
+
+
+def test_device_trace_capture(tmp_path):
+    trace_dir = str(tmp_path / "traces")
+    prof.start_profiler(trace_dir=trace_dir)
+    out = jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32)))
+    jax.block_until_ready(out)
+    prof.stop_profiler(print_table=False)
+    import os
+    found = []
+    for root, _, files in os.walk(trace_dir):
+        found += files
+    assert found, "jax.profiler produced no trace files"
