@@ -23,9 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import inspect
 import time
-import weakref
 from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
@@ -88,6 +86,27 @@ class TrainState:
         return {PARAMS: self.params, STATE: self.state}
 
 
+def host_step_of(ts: TrainState) -> int:
+    """Host-side value of ts.step without a device sync when possible.
+
+    Trainers stamp each returned TrainState with a `_step_hint` attribute
+    (plain Python int riding outside the pytree). A state that went through
+    a transform or checkpoint restore loses the hint and costs ONE
+    device_get — after which the hint rides along again. This keeps the
+    default-rng stream tied to the state itself, so rollbacks, multiple
+    states through one trainer, and resumed runs all stay reproducible.
+    """
+    hint = getattr(ts, "_step_hint", None)
+    if hint is None:
+        hint = int(jax.device_get(ts.step))
+    return hint
+
+
+def _stamp_step(ts: TrainState, step: int) -> TrainState:
+    ts._step_hint = step
+    return ts
+
+
 # --------------------------------------------------------------------------
 # Trainer: builds and caches the compiled train/eval step.
 # --------------------------------------------------------------------------
@@ -113,11 +132,6 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self.compile_count = 0
-        # Host-side step counter: lets the default-rng path fold in the step
-        # number without a device round-trip on ts.step every iteration.
-        # Seeded lazily from ts.step (one sync) so resumed runs continue the
-        # rng stream instead of replaying it from 0.
-        self._host_step: Optional[int] = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, *example_inputs, rng: Optional[jax.Array] = None
@@ -170,14 +184,13 @@ class Trainer:
                    ) -> Tuple[TrainState, Dict]:
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        if self._host_step is None:
-            self._host_step = int(jax.device_get(ts.step))
+        step_no = host_step_of(ts)
         if rng is None:
             rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
-                                     self._host_step)
-        self._host_step += 1
+                                     step_no)
         with RecordEvent("Trainer.train_step"):
             new_ts, fetches = self._train_step(ts, batch, rng)
+        _stamp_step(new_ts, step_no + 1)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
             check_nan_inf(new_ts.params, "params")
@@ -193,15 +206,12 @@ class Trainer:
             callback: Optional[Callable[[int, Dict], None]] = None
             ) -> TrainState:
         """Simple epoch loop (≈ tests/book training loops)."""
-        # One sync up front so resumed runs report true global steps; the
-        # steady-state loop then stays free of device round-trips.
-        self._host_step = int(jax.device_get(ts.step))
         step_t0, bench = time.perf_counter(), FLAGS.get("benchmark")
         for epoch in range(epochs):
             for batch in data:
                 ts, fetches = self.train_step(ts, batch)
-                # host-side counter: no device sync in the steady-state loop
-                s = self._host_step
+                # step hint rides on the state: no device sync in the loop
+                s = host_step_of(ts)
                 if callback is not None:
                     callback(s, fetches)
                 if bench and log_every and s % log_every == 0:
@@ -249,33 +259,15 @@ class Executor:
 
     def __init__(self, place: Optional[Any] = None):
         self.place = place or jax.devices()[0]
-        # Keyed on the program object itself (not id()): a WeakKeyDictionary
-        # entry dies with its function, so a recycled id can never be served
-        # a stale executable. Inner dict is keyed by the feed signature.
-        self._cache: "weakref.WeakKeyDictionary[Callable, Dict[Tuple, Callable]]" = (
-            weakref.WeakKeyDictionary())
-        # Strong-ref fallback for callables that don't support weakrefs:
-        # keeping the object alive means its identity can never be
-        # recycled, so the cache stays sound.
-        self._strong_cache: Dict[Callable, Dict[Tuple, Callable]] = {}
+        # Keyed on the program object itself (not id()): entries hold a
+        # strong reference, so an id can never be recycled and served a
+        # stale executable. Bound methods hash by (__self__, __func__), so
+        # the per-call method object still hits its entry. The compiled
+        # jax.jit wrapper references the program anyway, so weakrefs could
+        # never evict — a plain dict is the honest structure; close()
+        # releases everything.
+        self._cache: Dict[Callable, Dict[Tuple, Callable]] = {}
         self.cache_misses = 0
-
-    def _cache_bucket(self, program: Callable) -> Dict[Tuple, Callable]:
-        # Bound methods are ephemeral objects (a fresh one per attribute
-        # access) — keying on them would evict every entry immediately.
-        # Key on the stable underlying function, scoped per instance via a
-        # weakly-referenced bucket on the instance's entry.
-        if inspect.ismethod(program):
-            try:
-                inst_buckets = self._cache.setdefault(program.__self__, {})
-            except TypeError:
-                inst_buckets = self._strong_cache.setdefault(
-                    program.__self__, {})
-            return inst_buckets.setdefault(program.__func__, {})
-        try:
-            return self._cache.setdefault(program, {})
-        except TypeError:
-            return self._strong_cache.setdefault(program, {})
 
     @staticmethod
     def _signature(feed: Dict[str, Any]) -> Tuple:
@@ -291,7 +283,7 @@ class Executor:
         fetch_list] as numpy-convertible arrays (or the full dict)."""
         feed = feed or {}
         key = self._signature(feed)
-        per_fn = self._cache_bucket(program)
+        per_fn = self._cache.setdefault(program, {})
         if key not in per_fn:
             per_fn[key] = jax.jit(program)
             self.cache_misses += 1
@@ -311,7 +303,6 @@ class Executor:
 
     def close(self) -> None:
         self._cache.clear()
-        self._strong_cache.clear()
 
 
 class NaiveExecutor:

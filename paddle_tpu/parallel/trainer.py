@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.core.executor import TrainState, check_nan_inf
+from paddle_tpu.core.executor import (
+    TrainState, _stamp_step, check_nan_inf, host_step_of)
 from paddle_tpu.profiler.profiler import RecordEvent
 from paddle_tpu.core.module import Module, PARAMS, STATE
 from paddle_tpu.optim.optimizer import Optimizer
@@ -64,12 +65,6 @@ class MeshTrainer:
         self._train_step = None
         self._eval_step = None
         self._state_shardings = None
-        # Host-side step counter for the default-rng path: folding in
-        # ts.step would device_get every step and stall the dispatch
-        # pipeline (the reference overlaps feed/compute the same way).
-        # Seeded lazily from ts.step (one sync) so resumed runs continue
-        # the rng stream instead of replaying it from 0.
-        self._host_step: Optional[int] = None
 
     # -- sharding helpers -------------------------------------------------
     def batch_sharding(self, leaf=None) -> NamedSharding:
@@ -211,14 +206,16 @@ class MeshTrainer:
             raise RuntimeError("call init_state() first")
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        if self._host_step is None:
-            self._host_step = int(jax.device_get(ts.step))
+        # step hint rides on the state (see executor.host_step_of): the
+        # default-rng stream stays tied to ts.step without a device
+        # round-trip per step, and survives rollback/restore correctly.
+        step_no = host_step_of(ts)
         if rng is None:
             rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
-                                     self._host_step)
-        self._host_step += 1
+                                     step_no)
         with RecordEvent("MeshTrainer.train_step"), self.mesh:
             new_ts, fetches = self._train_step(ts, batch, rng)
+        _stamp_step(new_ts, step_no + 1)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
         return new_ts, fetches

@@ -77,3 +77,68 @@ def test_inference_export_roundtrip(tmp_path):
     out = pred.run({"x": np.asarray(x)})
     np.testing.assert_allclose(out[0], np.asarray(expected),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    """FSDP-sharded TrainState: shards written per owner, restored with
+    shardings= and identical layout (VERDICT weak #6 / SURVEY §5.4)."""
+    import json
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, ReduceStrategy, make_mesh)
+    from paddle_tpu.parallel.sharding import fsdp_rules
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    loss_fn = supervised_loss(
+        lambda logits, y: F.softmax_with_cross_entropy(logits, y))
+    tr = MeshTrainer(MLP(hidden=(64,), num_classes=8), Adam(1e-3), loss_fn,
+                     mesh,
+                     strategy=DistStrategy(
+                         reduce_strategy=ReduceStrategy.REDUCE),
+                     rules=fsdp_rules(min_size=64))
+    ts = tr.init_state(jnp.zeros((16, 32)))
+    x = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 8, 16)
+    ts, _ = tr.train_step(ts, tr.put_batch((x, y)), rng=jax.random.key(0))
+
+    # at least one leaf must actually be sharded (not fully replicated)
+    assert any(
+        not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree.leaves(ts) if isinstance(leaf, jax.Array))
+
+    path = save_checkpoint(str(tmp_path / "ck"), ts, step=1)
+    manifest = json.load(open(os.path.join(path, "manifest.json")))
+    assert manifest["version"] == 2
+    assert os.path.exists(os.path.join(path, "shards-p0.npz"))
+
+    restored = load_checkpoint(path, target=ts,
+                               shardings=tr._state_shardings)
+    for a, b in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        if isinstance(a, jax.Array):
+            assert b.sharding.is_equivalent_to(a.sharding, a.ndim)
+
+    # restored state must be directly usable by the compiled step
+    ts2, fetches = tr.train_step(restored, tr.put_batch((x, y)),
+                                 rng=jax.random.key(1))
+    assert np.isfinite(float(fetches["loss"]))
+
+
+def test_v1_checkpoint_read_compat(tmp_path):
+    """Old single-file checkpoints (version 1) still load."""
+    import json
+    tree = {"w": np.arange(6.0).reshape(2, 3), "b": np.zeros(3)}
+    path = str(tmp_path / "old")
+    os.makedirs(path)
+    leaves = []
+    arrays = {}
+    for i, (k, v) in enumerate(sorted(tree.items())):
+        arrays[f"a{i}"] = v
+        leaves.append({"key": k, "slot": f"a{i}", "shape": list(v.shape),
+                       "dtype": str(v.dtype)})
+    np.savez(os.path.join(path, "arrays.npz"), **arrays)
+    json.dump({"version": 1, "step": 7, "metadata": {}, "leaves": leaves},
+              open(os.path.join(path, "manifest.json"), "w"))
+    restored = load_checkpoint(path, target=tree)
+    np.testing.assert_allclose(restored["w"], tree["w"])
+    np.testing.assert_allclose(restored["b"], tree["b"])
