@@ -89,12 +89,13 @@ class TrainState:
 def host_step_of(ts: TrainState) -> int:
     """Host-side value of ts.step without a device sync when possible.
 
-    Trainers stamp each returned TrainState with a `_step_hint` attribute
-    (plain Python int riding outside the pytree). A state that went through
-    a transform or checkpoint restore loses the hint and costs ONE
-    device_get — after which the hint rides along again. This keeps the
-    default-rng stream tied to the state itself, so rollbacks, multiple
-    states through one trainer, and resumed runs all stay reproducible.
+    Trainers stamp returned TrainStates with a `_step_hint` attribute
+    (plain Python int riding outside the pytree) when the incoming state
+    carried one; a state that went through a pytree transform or a
+    checkpoint restore loses the hint and costs ONE device_get here.
+    The hot path never depends on this: the default rng stream is derived
+    from the device-resident ts.step inside the compiled step, so
+    host_step_of is only for host-side logging (fit, bench loops).
     """
     hint = getattr(ts, "_step_hint", None)
     if hint is None:
@@ -140,18 +141,26 @@ class Trainer:
             rng = jax.random.key(self.seed)
         variables = self.module.init(rng, *example_inputs)
         params = variables.get(PARAMS, {})
-        return TrainState(
+        return _stamp_step(TrainState(
             params=params,
             state=variables.get(STATE, {}),
             opt_state=self.optimizer.init(params),
             step=jnp.zeros((), jnp.int32),
-        )
+        ), 0)
 
     # -- step builders ----------------------------------------------------
     def _build_train_step(self):
         module, optimizer, loss_fn = self.module, self.optimizer, self.loss_fn
+        seed = self.seed
 
         def step_fn(ts: TrainState, batch, rng) -> Tuple[TrainState, Dict]:
+            if rng is None:
+                # Default rng stream derived from the device-resident step
+                # inside the compiled fn: no host sync, and the stream stays
+                # tied to the state itself (rollback/restore reproducible).
+                rng = jax.random.fold_in(jax.random.key(seed ^ 0x5EED),
+                                         ts.step)
+
             def loss_of(params):
                 variables = {PARAMS: params, STATE: ts.state}
                 (loss, aux), new_state = loss_fn(
@@ -184,13 +193,11 @@ class Trainer:
                    ) -> Tuple[TrainState, Dict]:
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        step_no = host_step_of(ts)
-        if rng is None:
-            rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
-                                     step_no)
         with RecordEvent("Trainer.train_step"):
             new_ts, fetches = self._train_step(ts, batch, rng)
-        _stamp_step(new_ts, step_no + 1)
+        hint = getattr(ts, "_step_hint", None)
+        if hint is not None:
+            _stamp_step(new_ts, hint + 1)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
             check_nan_inf(new_ts.params, "params")
@@ -207,11 +214,13 @@ class Trainer:
             ) -> TrainState:
         """Simple epoch loop (≈ tests/book training loops)."""
         step_t0, bench = time.perf_counter(), FLAGS.get("benchmark")
+        # one sync at most (restored states); the loop then counts locally
+        s = host_step_of(ts)
+        _stamp_step(ts, s)
         for epoch in range(epochs):
             for batch in data:
                 ts, fetches = self.train_step(ts, batch)
-                # step hint rides on the state: no device sync in the loop
-                s = host_step_of(ts)
+                s += 1
                 if callback is not None:
                     callback(s, fetches)
                 if bench and log_every and s % log_every == 0:

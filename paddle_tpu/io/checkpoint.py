@@ -82,10 +82,6 @@ def _index_to_json(index, shape) -> List[List[int]]:
     return out
 
 
-def _json_to_index(spans: List[List[int]]) -> Tuple[slice, ...]:
-    return tuple(slice(a, b) for a, b in spans)
-
-
 def _normalize(region: Tuple[slice, ...], shape: Tuple[int, ...]
                ) -> Tuple[Tuple[int, int], ...]:
     """Slices (possibly open-ended) → concrete (start, stop) per dim."""
@@ -112,7 +108,10 @@ def _mark_failure(path: str, proc: int, exc: BaseException) -> None:
 
 
 def _check_failures(path: str) -> None:
-    markers = sorted(glob.glob(os.path.abspath(path) + ".err-p*"))
+    # glob.escape: a checkpoint path containing [ ] ? * must not be
+    # treated as a pattern, or peer-failure markers become invisible.
+    markers = sorted(glob.glob(glob.escape(os.path.abspath(path))
+                               + ".err-p*"))
     if markers:
         msgs = []
         for m in markers:
@@ -127,7 +126,7 @@ def _check_failures(path: str) -> None:
 
 
 def _clear_markers(path: str) -> None:
-    for m in glob.glob(os.path.abspath(path) + ".err-p*"):
+    for m in glob.glob(glob.escape(os.path.abspath(path)) + ".err-p*"):
         try:
             os.remove(m)
         except OSError:
@@ -185,6 +184,10 @@ def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
         _barrier(f"ckpt-stage:{path}")
         _check_failures(path)
     else:
+        # Clear stale markers here too: a failed multi-host save followed by
+        # a single-process retry to the same path must not keep failing on
+        # the dead peer's marker.
+        _clear_markers(path)
         tmp = tempfile.mkdtemp(
             dir=os.path.dirname(os.path.abspath(path)) or ".")
     try:
@@ -437,6 +440,21 @@ class CheckpointManager:
             m = _CKPT_RE.match(name)
             if m:
                 entries.append((int(m.group(1)), name))
+            elif name.endswith(".ptmp") or ".err-p" in name:
+                # Debris from a save that crashed mid-flight (each save
+                # targets a fresh ckpt-{step} path, so its own retry-cleanup
+                # never runs for these): a .ptmp staging dir holds a full
+                # checkpoint's worth of shards and would otherwise leak
+                # forever. Anything still staging belongs to the save in
+                # progress right now — which is ours, already committed.
+                full = os.path.join(self.directory, name)
+                if os.path.isdir(full):
+                    shutil.rmtree(full, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(full)
+                    except OSError:
+                        pass
         entries.sort()
         for _, name in entries[:-self.max_to_keep]:
             shutil.rmtree(os.path.join(self.directory, name),

@@ -23,6 +23,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 # ---------------------------------------------------------------- activations
@@ -112,7 +113,8 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
                                axis: int = -1, ignore_index: int = -100):
     """Fused, numerically-stable version (reference
     softmax_with_cross_entropy_op.cc). Returns per-example loss."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    logp = jax.nn.log_softmax(
+        logits.astype(jnp.promote_types(logits.dtype, jnp.float32)), axis=axis)
     if soft_label:
         return -jnp.sum(label * logp, axis=axis)
     label = label.astype(jnp.int32)
@@ -125,8 +127,9 @@ def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
 
 def sigmoid_cross_entropy_with_logits(logits, label):
     """operators/sigmoid_cross_entropy_with_logits_op.cc."""
-    x = logits.astype(jnp.float32)
-    z = label.astype(jnp.float32)
+    ct = jnp.promote_types(logits.dtype, jnp.float32)
+    x = logits.astype(ct)
+    z = label.astype(ct)
     return jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
 
 
@@ -259,7 +262,7 @@ def concat(xs, axis: int = 0):
 def split(x, num_or_sections, axis: int = 0):
     if isinstance(num_or_sections, int):
         return jnp.split(x, num_or_sections, axis=axis)
-    offsets = list(jnp.cumsum(jnp.array(num_or_sections))[:-1])
+    offsets = np.cumsum(np.asarray(num_or_sections))[:-1]
     return jnp.split(x, [int(o) for o in offsets], axis=axis)
 
 

@@ -94,10 +94,17 @@ class ShardedEmbedding(Module):
         vocab = self._padded_vocab()
         table = cx.param("weight", (vocab, self.features),
                          self.embedding_init, self.param_dtype)
+        # Clamp into the real vocab BEFORE dispatch so both paths agree:
+        # without this, the mesh path could return an uninitialized padding
+        # row for ids in [num_embeddings, padded_vocab) and zeros for
+        # negative ids, while the dense path clamps — same model, different
+        # outputs. Clamping matches jnp.take's (and the dense Embedding's)
+        # out-of-range semantics everywhere.
+        lookup_ids = jnp.clip(ids, 0, self.num_embeddings - 1)
         if self.mesh is not None and self.mesh.shape[self.axis] > 1:
-            out = self._shard_map_lookup(table, ids)
+            out = self._shard_map_lookup(table, lookup_ids)
         else:
-            out = jnp.take(table, ids, axis=0)
+            out = jnp.take(table, lookup_ids, axis=0)
         out = out.astype(self.dtype)
         if self.padding_idx is not None:
             mask = (ids != self.padding_idx)[..., None]

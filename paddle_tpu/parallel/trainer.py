@@ -28,8 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from paddle_tpu.core.executor import (
-    TrainState, _stamp_step, check_nan_inf, host_step_of)
+from paddle_tpu.core.executor import TrainState, _stamp_step, check_nan_inf
 from paddle_tpu.profiler.profiler import RecordEvent
 from paddle_tpu.core.module import Module, PARAMS, STATE
 from paddle_tpu.optim.optimizer import Optimizer
@@ -109,8 +108,8 @@ class MeshTrainer:
         shardings = self.state_shardings(abstract)
         self._state_shardings = shardings
         with self.mesh:
-            return jax.jit(init_fn, out_shardings=shardings)(
-                rng, *example_inputs)
+            return _stamp_step(jax.jit(init_fn, out_shardings=shardings)(
+                rng, *example_inputs), 0)
 
     # -- step construction ------------------------------------------------
     def _loss_and_grads(self, ts: TrainState, batch, rng):
@@ -139,8 +138,15 @@ class MeshTrainer:
     def _build_train_step(self):
         accum = self.strategy.gradient_accumulation_steps
         optimizer = self.optimizer
+        seed = self.seed
 
         def step_fn(ts: TrainState, batch, rng):
+            if rng is None:
+                # default rng stream from the device-resident step: no host
+                # sync, reproducible across rollback/restore (see
+                # core.executor.Trainer._build_train_step)
+                rng = jax.random.fold_in(jax.random.key(seed ^ 0x5EED),
+                                         ts.step)
             if accum <= 1:
                 loss, aux, new_state, grads = self._loss_and_grads(
                     ts, batch, rng)
@@ -206,16 +212,11 @@ class MeshTrainer:
             raise RuntimeError("call init_state() first")
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        # step hint rides on the state (see executor.host_step_of): the
-        # default-rng stream stays tied to ts.step without a device
-        # round-trip per step, and survives rollback/restore correctly.
-        step_no = host_step_of(ts)
-        if rng is None:
-            rng = jax.random.fold_in(jax.random.key(self.seed ^ 0x5EED),
-                                     step_no)
         with RecordEvent("MeshTrainer.train_step"), self.mesh:
             new_ts, fetches = self._train_step(ts, batch, rng)
-        _stamp_step(new_ts, step_no + 1)
+        hint = getattr(ts, "_step_hint", None)
+        if hint is not None:
+            _stamp_step(new_ts, hint + 1)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
         return new_ts, fetches

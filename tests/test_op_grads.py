@@ -1,0 +1,362 @@
+"""Numeric-gradient checks for the op library.
+
+TPU-native counterpart of the reference's per-op `check_grad` coverage
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:414 via
+get_numeric_gradient :43): every differentiable op in ops/functional.py and
+ops/sequence.py is checked against central finite differences in float64.
+
+Shapes are tiny on purpose — numeric_grad is O(n) function evaluations.
+Inputs are sampled away from non-differentiable points (relu kinks, max
+ties, clip boundaries) exactly as the reference tests bias their inputs.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu.ops.functional as F
+import paddle_tpu.ops.sequence as S
+from paddle_tpu.testing import check_grad
+
+RNG = np.random.RandomState(42)
+
+
+def _x(*shape, lo=-1.0, hi=1.0, away_from=None, margin=0.1):
+    """Uniform sample in [lo, hi], nudged `margin` away from kink points."""
+    x = RNG.uniform(lo, hi, shape).astype(np.float64)
+    if away_from is not None:
+        for p in np.atleast_1d(away_from):
+            near = np.abs(x - p) < margin
+            x = np.where(near, p + margin * np.sign(x - p + 1e-12), x)
+    return x
+
+
+# ------------------------------------------------------------- activations
+
+SMOOTH_ACTS = ["sigmoid", "tanh", "softplus", "softsign", "gelu", "silu",
+               "swish", "stanh", "soft_relu"]
+KINKED_ACTS = ["relu", "relu6", "leaky_relu", "elu"]
+
+
+@pytest.mark.parametrize("name", SMOOTH_ACTS)
+def test_smooth_activation_grad(name):
+    check_grad(F.activation(name), _x(2, 5), name=name)
+
+
+@pytest.mark.parametrize("name", KINKED_ACTS)
+def test_kinked_activation_grad(name):
+    check_grad(F.activation(name), _x(2, 5, lo=-3, hi=3, away_from=[0, 6]),
+               name=name)
+
+
+def test_brelu_grad():
+    check_grad(lambda x: F.brelu(x, 0.0, 2.0),
+               _x(2, 5, lo=-1, hi=3, away_from=[0.0, 2.0]), name="brelu")
+
+
+def test_hard_sigmoid_grad():
+    check_grad(F.hard_sigmoid, _x(2, 5, away_from=[-2.5, 2.5]),
+               name="hard_sigmoid")
+
+
+def test_maxout_grad():
+    check_grad(lambda x: F.maxout(x, 2), _x(2, 3, 8), name="maxout")
+
+
+# ---------------------------------------------------------- softmax/losses
+
+def test_softmax_grad():
+    check_grad(F.softmax, _x(3, 5), name="softmax")
+
+
+def test_log_softmax_grad():
+    check_grad(F.log_softmax, _x(3, 5), name="log_softmax")
+
+
+def test_cross_entropy_grad():
+    probs = RNG.dirichlet(np.ones(5), size=3)
+    labels = np.array([0, 2, 4])
+    check_grad(lambda p: F.cross_entropy(p, labels), probs,
+               name="cross_entropy")
+
+
+def test_cross_entropy_soft_grad():
+    probs = RNG.dirichlet(np.ones(5), size=3)
+    soft = RNG.dirichlet(np.ones(5), size=3)
+    check_grad(lambda p: F.cross_entropy(p, soft, soft_label=True), probs,
+               name="cross_entropy_soft")
+
+
+def test_softmax_with_cross_entropy_grad():
+    labels = np.array([1, 3, 0])
+    check_grad(lambda z: F.softmax_with_cross_entropy(z, labels), _x(3, 5),
+               name="softmax_with_cross_entropy")
+
+
+def test_softmax_with_cross_entropy_ignore_grad():
+    labels = np.array([1, -100, 0])
+    check_grad(lambda z: F.softmax_with_cross_entropy(z, labels), _x(3, 5),
+               name="softmax_with_cross_entropy_ignore")
+
+
+def test_sigmoid_cross_entropy_grad():
+    y = RNG.randint(0, 2, (3, 4)).astype(np.float64)
+    check_grad(lambda z: F.sigmoid_cross_entropy_with_logits(z, y), _x(3, 4),
+               name="sigmoid_cross_entropy_with_logits")
+
+
+@pytest.mark.parametrize("fn", [F.square_error_cost, F.huber_loss,
+                                F.margin_rank_loss, F.hinge_loss, F.mse_loss])
+def test_two_arg_loss_grad(fn):
+    if fn is F.margin_rank_loss:
+        lbl = np.where(RNG.rand(3, 4) > 0.5, 1.0, -1.0)
+        check_grad(lambda a, b: fn(a, b, lbl),
+                   _x(3, 4, lo=-2, hi=2), _x(3, 4, lo=2.5, hi=4),
+                   name=fn.__name__)
+    elif fn is F.hinge_loss:
+        lbl = RNG.randint(0, 2, (3, 4)).astype(np.float64)
+        check_grad(lambda z: fn(z, lbl), _x(3, 4, away_from=[-1.0, 1.0]),
+                   name=fn.__name__)
+    else:
+        check_grad(fn, _x(3, 4), _x(3, 4, lo=2, hi=3), name=fn.__name__)
+
+
+def test_smooth_l1_grad():
+    # keep |x-y| away from the 1/sigma^2 kink
+    x = _x(3, 4, lo=-0.2, hi=0.2)
+    y = x + np.where(RNG.rand(3, 4) > 0.5, 0.5, 2.0) * np.sign(RNG.randn(3, 4))
+    check_grad(F.smooth_l1, x, y, name="smooth_l1")
+
+
+def test_kldiv_loss_grad():
+    target = RNG.dirichlet(np.ones(4), size=3)
+    check_grad(lambda lp: F.kldiv_loss(lp, target),
+               np.log(RNG.dirichlet(np.ones(4), size=3)), name="kldiv")
+
+
+def test_log_loss_grad():
+    y = RNG.randint(0, 2, (6,)).astype(np.float64)
+    check_grad(lambda p: F.log_loss(p, y), _x(6, lo=0.05, hi=0.95),
+               name="log_loss")
+
+
+def test_l2_normalize_grad():
+    check_grad(F.l2_normalize, _x(3, 4), name="l2_normalize")
+
+
+def test_cos_sim_grad():
+    check_grad(F.cos_sim, _x(3, 4), _x(3, 4), name="cos_sim")
+
+
+# ------------------------------------------------------------- elementwise
+
+@pytest.mark.parametrize("fn", [F.elementwise_add, F.elementwise_sub,
+                                F.elementwise_mul, F.elementwise_div])
+def test_elementwise_grad(fn):
+    check_grad(fn, _x(2, 3, 4), _x(2, 3, 4, lo=1, hi=2), name=fn.__name__)
+
+
+def test_elementwise_broadcast_grad():
+    check_grad(F.elementwise_add, _x(2, 3, 4), _x(3, 1), name="ew_broadcast")
+
+
+def test_elementwise_minmax_grad():
+    a, b = _x(3, 4), _x(3, 4, lo=2, hi=3)  # disjoint ranges: no ties
+    check_grad(F.elementwise_min, a, b, name="elementwise_min")
+    check_grad(F.elementwise_max, a, b, name="elementwise_max")
+
+
+def test_elementwise_pow_grad():
+    check_grad(F.elementwise_pow, _x(3, 4, lo=0.5, hi=2.0),
+               _x(3, 4, lo=1.0, hi=3.0), name="elementwise_pow")
+
+
+# -------------------------------------------------------------- reductions
+
+@pytest.mark.parametrize("fn,dim", [
+    (F.reduce_sum, None), (F.reduce_sum, 1), (F.reduce_mean, None),
+    (F.reduce_mean, (0, 2)), (F.reduce_prod, 1)])
+def test_reduce_grad(fn, dim):
+    check_grad(lambda x: fn(x, dim=dim), _x(2, 3, 4, lo=0.5, hi=1.5),
+               name=f"{fn.__name__}:{dim}")
+
+
+def test_reduce_minmax_grad():
+    x = np.arange(24, dtype=np.float64).reshape(2, 3, 4)  # unique: no ties
+    x += RNG.uniform(0, 0.4, x.shape)
+    check_grad(lambda a: F.reduce_max(a, dim=1), x, name="reduce_max")
+    check_grad(lambda a: F.reduce_min(a, dim=(0, 2)), x, name="reduce_min")
+
+
+# ------------------------------------------------------------ tensor munge
+
+def test_clip_grad():
+    check_grad(lambda x: F.clip(x, -0.5, 0.5),
+               _x(3, 4, away_from=[-0.5, 0.5]), name="clip")
+
+
+def test_clip_by_norm_grad():
+    check_grad(lambda x: F.clip_by_norm(x, 1.0), _x(3, 4, lo=1, hi=2),
+               name="clip_by_norm_clipped")
+    check_grad(lambda x: F.clip_by_norm(x, 100.0), _x(3, 4),
+               name="clip_by_norm_passthrough")
+
+
+def test_scale_grad():
+    check_grad(lambda x: F.scale(x, 2.5, 1.0), _x(3, 4), name="scale")
+    check_grad(lambda x: F.scale(x, 2.5, 1.0, bias_after_scale=False),
+               _x(3, 4), name="scale_bias_first")
+
+
+def test_topk_grad():
+    x = np.arange(12, dtype=np.float64).reshape(3, 4)
+    x += RNG.uniform(0, 0.4, x.shape)
+    check_grad(lambda a: F.topk(a, 2)[0], x, name="topk")
+
+
+def test_argsort_grad():
+    x = np.arange(12, dtype=np.float64).reshape(3, 4)
+    x += RNG.uniform(0, 0.4, x.shape)
+    check_grad(lambda a: F.argsort(a, descending=True)[0], x, name="argsort")
+
+
+def test_concat_split_stack_grad():
+    check_grad(lambda a, b: F.concat([a, b], axis=1), _x(2, 3), _x(2, 4),
+               name="concat")
+    check_grad(lambda a: F.split(a, 2, axis=1), _x(2, 4), name="split")
+    check_grad(lambda a: F.split(a, [1, 3], axis=1), _x(2, 4),
+               name="split_sections")
+    check_grad(lambda a, b: F.stack([a, b], axis=1), _x(2, 3), _x(2, 3),
+               name="stack")
+
+
+def test_shape_op_grads():
+    check_grad(lambda a: F.transpose(a, (1, 0, 2)), _x(2, 3, 4),
+               name="transpose")
+    check_grad(lambda a: F.reshape(a, (6, 4)), _x(2, 3, 4), name="reshape")
+    check_grad(lambda a: F.squeeze(a, 1), _x(3, 1, 4), name="squeeze")
+    check_grad(lambda a: F.unsqueeze(a, [0, 2]), _x(3, 4), name="unsqueeze")
+    check_grad(lambda a: F.expand(a, (2, 3)), _x(2, 3), name="expand")
+
+
+def test_gather_scatter_grad():
+    idx = np.array([2, 0, 1], np.int32)
+    check_grad(lambda a: F.gather(a, idx), _x(4, 3), name="gather")
+    nd = np.array([[0, 1], [2, 0]], np.int32)
+    check_grad(lambda a: F.gather_nd(a, nd), _x(3, 4), name="gather_nd")
+    check_grad(lambda a, u: F.scatter(a, idx, u), _x(4, 3), _x(3, 3),
+               name="scatter_overwrite")
+    check_grad(lambda a, u: F.scatter(a, idx, u, overwrite=False),
+               _x(4, 3), _x(3, 3), name="scatter_add")
+
+
+def test_where_grad():
+    cond = RNG.rand(3, 4) > 0.5
+    check_grad(lambda a, b: F.where(cond, a, b), _x(3, 4), _x(3, 4),
+               name="where")
+
+
+@pytest.mark.parametrize("exclusive,reverse", [(False, False), (True, False),
+                                               (False, True), (True, True)])
+def test_cumsum_grad(exclusive, reverse):
+    check_grad(lambda a: F.cumsum(a, 1, exclusive, reverse), _x(3, 4),
+               name=f"cumsum:{exclusive}:{reverse}")
+
+
+def test_label_smooth_grad():
+    check_grad(lambda a: F.label_smooth(a, 0.1), _x(3, 4, lo=0, hi=1),
+               name="label_smooth")
+
+
+def test_pad_grad():
+    check_grad(lambda a: F.pad(a, [(1, 0), (2, 1)], 0.5), _x(2, 3),
+               name="pad")
+
+
+def test_pixel_shuffle_grad():
+    check_grad(lambda a: F.pixel_shuffle(a, 2), _x(1, 2, 2, 8),
+               name="pixel_shuffle")
+
+
+def test_resize_grad():
+    check_grad(lambda a: F.resize_nearest(a, (4, 4)), _x(1, 2, 2, 2),
+               name="resize_nearest")
+    check_grad(lambda a: F.resize_bilinear(a, (4, 4)), _x(1, 2, 2, 2),
+               name="resize_bilinear")
+    check_grad(lambda a: F.resize_bilinear(a, (4, 4), align_corners=True),
+               _x(1, 2, 2, 2), name="resize_bilinear_corners")
+
+
+# ---------------------------------------------------------- sequence ops
+
+LENS = np.array([3, 1, 4], np.int32)
+
+
+@pytest.mark.parametrize("pool", ["sum", "average", "sqrt", "max", "last"])
+def test_sequence_pool_grad(pool):
+    x = _x(3, 4, 2)
+    if pool == "max":  # unique values: no ties at the max
+        x = np.arange(24, dtype=np.float64).reshape(3, 4, 2) * 0.1
+        x += RNG.uniform(0, 0.04, x.shape)
+    check_grad(lambda a: S.sequence_pool(a, LENS, pool), x,
+               name=f"sequence_pool:{pool}")
+
+
+def test_sequence_softmax_grad():
+    check_grad(lambda a: S.sequence_softmax(a, LENS), _x(3, 4),
+               name="sequence_softmax")
+
+
+def test_segment_pool_grad():
+    def f(x):
+        r = S.pack_padded(x, LENS)
+        return S.segment_pool(r, "sum")
+    check_grad(f, _x(3, 4, 2), name="segment_pool_sum")
+
+
+def test_pack_pad_roundtrip_grad():
+    def f(x):
+        r = S.pack_padded(x, LENS)
+        out, _ = S.pad_packed(r, 4)
+        return out
+    check_grad(f, _x(3, 4, 2), name="pack_pad_roundtrip")
+
+
+def test_sequence_reverse_grad():
+    check_grad(lambda a: S.sequence_reverse(a, LENS), _x(3, 4, 2),
+               name="sequence_reverse")
+
+
+def test_sequence_expand_padded_grad():
+    check_grad(lambda a: S.sequence_expand_padded(a, LENS, 4), _x(3, 2),
+               name="sequence_expand_padded")
+
+
+def test_sequence_conv_grad():
+    check_grad(lambda a, w: S.sequence_conv(a, LENS, w, context_size=3),
+               _x(3, 4, 2), _x(6, 3), name="sequence_conv")
+
+
+def test_sequence_slice_grad():
+    off = np.array([0, 0, 1], np.int32)
+    check_grad(lambda a: S.sequence_slice(a, LENS, off, 2)[0], _x(3, 4, 2),
+               name="sequence_slice")
+
+
+def test_sequence_concat_grad():
+    l2 = np.array([1, 2, 1], np.int32)
+    check_grad(
+        lambda a, b: S.sequence_concat([a, b], [LENS, l2], maxlen=6)[0],
+        _x(3, 4, 2), _x(3, 2, 2), name="sequence_concat")
+
+
+# ------------------------------------------------------- attention (XLA path)
+
+def test_attention_grad():
+    from paddle_tpu.kernels.attention import mha
+    q, k, v = _x(1, 4, 2, 3), _x(1, 4, 2, 3), _x(1, 4, 2, 3)
+    check_grad(lambda a, b, c: mha(a, b, c, causal=True),
+               q, k, v, name="mha_causal")
+    check_grad(lambda a, b, c: mha(a, b, c, kv_len=3),
+               q, k, v, name="mha_kv_len")
+
+
