@@ -1,6 +1,7 @@
-from paddle_tpu.data import readers, datasets
+from paddle_tpu.data import bucketing, readers, datasets
 from paddle_tpu.data.readers import (
     batch, buffered, cache, chain, compose, firstn, map_readers, shuffle,
     xmap_readers,
 )
+from paddle_tpu.data.bucketing import bucket_boundaries, bucket_by_length
 from paddle_tpu.data.feeder import DataFeeder, device_prefetch

@@ -35,10 +35,19 @@ class BeamResult(NamedTuple):
 
 def beam_search(decode_fn: Callable, init_state: Any, batch: int,
                 beam_size: int, max_len: int, bos_id: int, eos_id: int,
-                vocab_size: int, length_penalty: float = 0.0) -> BeamResult:
+                vocab_size: int, length_penalty: float = 0.0,
+                early_exit: bool = False) -> BeamResult:
     """Run beam search. `init_state` is a pytree whose leaves have leading
     dim B*K (tile per-sample state beam_size times first — see
-    `tile_beams`)."""
+    `tile_beams`).
+
+    early_exit=True runs the decode as a `lax.while_loop` that stops as
+    soon as every beam has emitted eos (the length-adaptive capability of
+    the reference's While-op-based dynamic decode, control_flow.py:1395 +
+    beam_search_op) instead of always scanning max_len positions. Output
+    buffers keep the static [B, K, max_len] shape; only the trip count is
+    dynamic, so XLA still compiles one program.
+    """
     bk = batch * beam_size
 
     # initial beams: beam 0 live with score 0, others -inf (standard trick
@@ -49,8 +58,8 @@ def beam_search(decode_fn: Callable, init_state: Any, batch: int,
     init_finished = jnp.zeros((batch, beam_size), jnp.bool_)
     init_lengths = jnp.zeros((batch, beam_size), jnp.int32)
 
-    def step(carry, pos):
-        tokens, scores, finished, lengths, state = carry
+    def expand(tokens, scores, finished, lengths, state, pos):
+        """One beam expansion at position `pos` (beam_search_op body)."""
         log_probs, new_state = decode_fn(tokens, pos, state)
         log_probs = log_probs.reshape(batch, beam_size, vocab_size)
         log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), -1)
@@ -76,25 +85,53 @@ def beam_search(decode_fn: Callable, init_state: Any, batch: int,
         parent_len = jnp.take_along_axis(lengths, parent, axis=1)
         was_fin = jnp.take_along_axis(finished, parent, axis=1)
         new_lengths = jnp.where(was_fin, parent_len, parent_len + 1)
+        return token, parent, top_scores, new_finished, new_lengths, new_state
 
-        new_carry = (token.reshape(-1), top_scores, new_finished,
-                     new_lengths, new_state)
-        return new_carry, (token, parent)
+    if early_exit:
+        # identity parents + eos tokens in unwritten tail positions keep
+        # the backtrack pass correct for early-stopped decodes
+        tok_hist0 = jnp.full((max_len, batch, beam_size), eos_id, jnp.int32)
+        parent_hist0 = jnp.tile(
+            jnp.arange(beam_size, dtype=jnp.int32)[None, None],
+            (max_len, batch, 1))
 
-    carry = (init_tokens, init_scores, init_finished, init_lengths,
-             init_state)
-    carry, (tok_hist, parent_hist) = lax.scan(
-        step, carry, jnp.arange(max_len))
-    _, final_scores, _, final_lengths, _ = carry
+        def w_cond(carry):
+            t, _, _, finished, _, _, _, _ = carry
+            return jnp.logical_and(t < max_len, ~jnp.all(finished))
+
+        def w_body(carry):
+            (t, tokens, scores, finished, lengths, state,
+             tok_hist, parent_hist) = carry
+            token, parent, scores, finished, lengths, state = expand(
+                tokens, scores, finished, lengths, state, t)
+            tok_hist = tok_hist.at[t].set(token)
+            parent_hist = parent_hist.at[t].set(parent)
+            return (t + 1, token.reshape(-1), scores, finished, lengths,
+                    state, tok_hist, parent_hist)
+
+        carry = (jnp.zeros((), jnp.int32), init_tokens, init_scores,
+                 init_finished, init_lengths, init_state,
+                 tok_hist0, parent_hist0)
+        (_, _, final_scores, _, final_lengths, _, tok_hist,
+         parent_hist) = lax.while_loop(w_cond, w_body, carry)
+    else:
+        def step(carry, pos):
+            tokens, scores, finished, lengths, state = carry
+            token, parent, scores, finished, lengths, state = expand(
+                tokens, scores, finished, lengths, state, pos)
+            new_carry = (token.reshape(-1), scores, finished, lengths, state)
+            return new_carry, (token, parent)
+
+        carry = (init_tokens, init_scores, init_finished, init_lengths,
+                 init_state)
+        carry, (tok_hist, parent_hist) = lax.scan(
+            step, carry, jnp.arange(max_len))
+        _, final_scores, _, final_lengths, _ = carry
 
     # ---- backtrack (beam_search_decode capability) ----
-    def back(beam_idx, t):
+    def back_step(beam_idx, t):
         tok = jnp.take_along_axis(tok_hist[t], beam_idx, axis=1)
         par = jnp.take_along_axis(parent_hist[t], beam_idx, axis=1)
-        return par, tok
-
-    def back_step(beam_idx, t):
-        par, tok = back(beam_idx, t)
         return par, tok
 
     beam_idx = jnp.tile(jnp.arange(beam_size)[None], (batch, 1))

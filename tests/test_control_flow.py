@@ -1,0 +1,221 @@
+"""Control-flow + bucketing + dynamic-decode tests.
+
+Reference bar: operators/controlflow/while_op.cc:50 (While runs to a
+data-dependent trip count), layers/control_flow.py:1139 (Switch),
+:278 (StaticRNN), :1395 (DynamicRNN ragged semantics), and the
+beam_search dynamic-decode stack (beam_search_op.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import control_flow as cf
+
+
+def test_while_loop_basic():
+    out = cf.while_loop(lambda x: x < 100, lambda x: x * 2,
+                        jnp.asarray(3))
+    assert int(out) == 192
+
+
+def test_while_loop_under_jit_traced_bound():
+    f = jax.jit(lambda n: cf.while_loop(lambda c: c[0] < n,
+                                        lambda c: (c[0] + 1, c[1] + c[0]),
+                                        (jnp.asarray(0), jnp.asarray(0))))
+    i, s = f(jnp.asarray(5))
+    assert int(i) == 5 and int(s) == 10
+
+
+def test_while_loop_max_iter():
+    out = cf.while_loop(lambda x: x > 0, lambda x: x + 1,
+                        jnp.asarray(1), max_iter=7)
+    assert int(out) == 8  # would run forever without the bound
+
+
+def test_fori_loop():
+    out = cf.fori_loop(0, 10, lambda i, acc: acc + i, jnp.asarray(0))
+    assert int(out) == 45
+
+
+def test_cond_both_branches():
+    f = jax.jit(lambda p, x: cf.cond(p, lambda a: a * 2, lambda a: a - 1, x))
+    assert int(f(True, jnp.asarray(4))) == 8
+    assert int(f(False, jnp.asarray(4))) == 3
+
+
+def test_switch():
+    branches = [lambda x: x + 10, lambda x: x * 10, lambda x: -x]
+    f = jax.jit(lambda i, x: cf.switch(i, branches, x))
+    assert int(f(0, jnp.asarray(2))) == 12
+    assert int(f(1, jnp.asarray(2))) == 20
+    assert int(f(2, jnp.asarray(2))) == -2
+    assert int(f(9, jnp.asarray(2))) == -2  # clamped
+
+
+def test_case_first_match_wins():
+    x = jnp.asarray(3.0)
+
+    def f(v):
+        return cf.case([(v < 1.0, lambda: jnp.asarray(10.0)),
+                        (v < 5.0, lambda: jnp.asarray(20.0)),
+                        (v < 100.0, lambda: jnp.asarray(30.0))])
+    assert float(jax.jit(f)(x)) == 20.0
+    assert float(jax.jit(f)(jnp.asarray(0.5))) == 10.0
+    assert float(jax.jit(f)(jnp.asarray(50.0))) == 30.0
+
+
+def test_case_default():
+    out = cf.case([(jnp.asarray(False), lambda: jnp.asarray(1.0))],
+                  lambda: jnp.asarray(-1.0))
+    assert float(out) == -1.0
+
+
+def test_case_with_operands():
+    x = jnp.asarray(3.0)
+    out = cf.case([(jnp.asarray(False), lambda a: a + 1),
+                   (jnp.asarray(True), lambda a: a * 2)],
+                  default=lambda a: -a, operands=(x,))
+    assert float(out) == 6.0
+
+
+def test_piecewise_lr_schedule():
+    # the piecewise_decay idiom: boundaries [100, 200], values [1.0, .5, .1]
+    f = jax.jit(lambda step: cf.piecewise(step, [100, 200], [1.0, 0.5, 0.1]))
+    assert float(f(0)) == pytest.approx(1.0)
+    assert float(f(100)) == pytest.approx(0.5)
+    assert float(f(150)) == pytest.approx(0.5)
+    assert float(f(500)) == pytest.approx(0.1)
+
+
+def test_static_rnn_matches_python_loop():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 5, 3), jnp.float32)
+    w = jnp.asarray(rs.randn(3, 3), jnp.float32)
+
+    def step(h, x_t):
+        h2 = jnp.tanh(x_t @ w + h)
+        return h2, h2
+
+    ys, final = cf.static_rnn(step, x, jnp.zeros((2, 3)))
+    # python reference
+    h = np.zeros((2, 3), np.float32)
+    for t in range(5):
+        h = np.tanh(np.asarray(x[:, t]) @ np.asarray(w) + h)
+    np.testing.assert_allclose(np.asarray(final), h, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ys[:, -1]), h, rtol=1e-5)
+
+
+def test_static_rnn_ragged_freezes_state():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(3, 6, 4), jnp.float32)
+    lengths = jnp.asarray([6, 2, 4], jnp.int32)
+
+    def step(h, x_t):
+        h2 = h + jnp.sum(x_t, axis=-1, keepdims=True)
+        return h2, h2
+
+    ys, final = cf.static_rnn(step, x, jnp.zeros((3, 1)), lengths=lengths)
+    # final state of row 1 must equal its state at t=2 (frozen after)
+    expect = float(jnp.sum(x[1, :2]))
+    assert abs(float(final[1, 0]) - expect) < 1e-5
+    # outputs past the length are zeroed
+    assert float(jnp.abs(ys[1, 2:]).sum()) == 0.0
+
+
+# ---------------------------------------------------------------- bucketing
+
+def test_bucket_boundaries():
+    from paddle_tpu.data.bucketing import bucket_boundaries
+    bs = bucket_boundaries(100, min_len=8, growth=2.0)
+    assert bs[0] == 8 and bs[-1] == 100
+    assert all(b2 > b1 for b1, b2 in zip(bs, bs[1:]))
+
+
+def test_bucket_by_length():
+    from paddle_tpu.data.bucketing import bucket_by_length
+    rs = np.random.RandomState(0)
+    samples = [(np.arange(n), int(n % 2)) for n in
+               rs.randint(1, 33, size=50)]
+
+    def reader():
+        return iter(samples)
+
+    batches = list(bucket_by_length(reader, [8, 16, 32], batch_size=4)())
+    total = 0
+    for toks, labels, lens in batches:
+        assert toks.shape[1] in (8, 16, 32)
+        assert toks.shape[0] == labels.shape[0] == lens.shape[0] <= 4
+        # padding correctness: row i has lens[i] real tokens then zeros
+        for i in range(toks.shape[0]):
+            np.testing.assert_array_equal(toks[i, :lens[i]],
+                                          np.arange(lens[i]))
+            assert np.all(toks[i, lens[i]:] == 0)
+        total += toks.shape[0]
+    assert total == 50  # flush emits leftovers
+
+
+def test_bucket_fixed_fields_not_padded():
+    """Fixed-size side fields (dense features) must keep their shape; only
+    length-shaped fields pad to the bucket edge."""
+    from paddle_tpu.data.bucketing import bucket_by_length
+    rs = np.random.RandomState(0)
+    samples = [(np.arange(n), rs.randn(4).astype(np.float32), int(n % 2))
+               for n in [3, 5, 7, 2, 9, 11]]
+    batches = list(bucket_by_length(lambda: iter(samples), [8, 16],
+                                    batch_size=3)())
+    for toks, dense, label, lens in batches:
+        assert dense.shape[1] == 4          # NOT padded to the bucket edge
+        assert toks.shape[1] in (8, 16)
+        assert label.ndim == 1
+
+
+def test_bucket_shapes_are_reused():
+    from paddle_tpu.data.bucketing import bucket_by_length
+    samples = [(np.arange(n),) for n in [3, 5, 7, 2, 9, 11, 15, 4]]
+    batches = list(bucket_by_length(lambda: iter(samples), [8, 16],
+                                    batch_size=2, with_lengths=False)())
+    shapes = {b[0].shape[1] for b in batches}
+    assert shapes <= {8, 16}  # only two compiled shapes ever
+
+
+# ----------------------------------------------------- dynamic decode
+
+def _toy_decode_fn(vocab=7, eos=2):
+    """Deterministic toy LM: always prefers token (pos + 3) % vocab until
+    pos 3, then eos — so every beam finishes at length 4."""
+    def decode_fn(tokens, pos, state):
+        bk = tokens.shape[0]
+        logits = jnp.zeros((bk, vocab))
+        tok = jnp.where(pos < 3, (pos + 3) % vocab, eos)
+        logits = logits.at[:, tok].set(5.0)
+        return logits, state
+    return decode_fn
+
+
+@pytest.mark.parametrize("early_exit", [False, True])
+def test_beam_search_early_exit_matches_scan(early_exit):
+    from paddle_tpu.ops.beam_search import beam_search
+    res = beam_search(_toy_decode_fn(), init_state={}, batch=2, beam_size=3,
+                      max_len=12, bos_id=0, eos_id=2, vocab_size=7,
+                      early_exit=early_exit)
+    assert res.tokens.shape == (2, 3, 12)
+    # best beam decodes 3,4,5,eos then eos-padding
+    np.testing.assert_array_equal(np.asarray(res.tokens[0, 0, :4]),
+                                  [3, 4, 5, 2])
+    assert np.all(np.asarray(res.tokens[:, :, 4:]) == 2)
+    assert int(res.lengths[0, 0]) == 4
+
+
+def test_beam_search_early_exit_equivalence():
+    """Early-exit and full-scan must produce identical results."""
+    from paddle_tpu.ops.beam_search import beam_search
+    kw = dict(decode_fn=_toy_decode_fn(), init_state={}, batch=2,
+              beam_size=3, max_len=10, bos_id=0, eos_id=2, vocab_size=7)
+    a = beam_search(early_exit=False, **kw)
+    b = beam_search(early_exit=True, **kw)
+    np.testing.assert_array_equal(np.asarray(a.tokens), np.asarray(b.tokens))
+    np.testing.assert_allclose(np.asarray(a.scores), np.asarray(b.scores),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(a.lengths),
+                                  np.asarray(b.lengths))
