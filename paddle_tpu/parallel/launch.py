@@ -1,0 +1,113 @@
+"""Multi-process launcher.
+
+Capability-equivalent of /root/reference/python/paddle/distributed/launch.py
+(one process per device, PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS env
+contract) — here one process per *host* (TPU processes own all their local
+chips), with the PTPU_* env contract consumed by
+paddle_tpu.parallel.distributed.init_distributed:
+
+    python -m paddle_tpu.parallel.launch --nproc 2 train.py --lr 0.1
+
+--cpu_devices_per_proc N forces the CPU backend with N virtual devices per
+process — the multi-process-on-localhost test recipe (reference
+test_dist_base.py:341 spawns localhost pservers/trainers the same way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Optional, Sequence
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(nproc: int, command: Sequence[str],
+           coordinator: Optional[str] = None,
+           cpu_devices_per_proc: Optional[int] = None,
+           env: Optional[dict] = None,
+           timeout: float = 600.0) -> List[subprocess.CompletedProcess]:
+    """Spawn `nproc` copies of `command` wired into one jax.distributed
+    world. Returns per-process CompletedProcess (stdout/stderr captured).
+    Raises RuntimeError if any process fails — with every log tail, since
+    a dead peer usually makes the others die of barrier timeouts."""
+    coordinator = coordinator or f"127.0.0.1:{free_port()}"
+    procs = []
+    for i in range(nproc):
+        penv = dict(os.environ)
+        penv.update(env or {})
+        penv["PTPU_COORDINATOR"] = coordinator
+        penv["PTPU_NUM_PROCESSES"] = str(nproc)
+        penv["PTPU_PROCESS_ID"] = str(i)
+        if cpu_devices_per_proc:
+            # localhost test mode: virtual CPU devices, no TPU grab
+            penv.pop("PALLAS_AXON_POOL_IPS", None)
+            penv["JAX_PLATFORMS"] = "cpu"
+            flags = [f for f in penv.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count="
+                         f"{cpu_devices_per_proc}")
+            penv["XLA_FLAGS"] = " ".join(flags)
+        procs.append(subprocess.Popen(
+            list(command), env=penv, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+
+    # Drain every process concurrently: sequential communicate() deadlocks
+    # when a later process fills its ~64KB pipe buffer and blocks while the
+    # first one waits for it at a collective.
+    import concurrent.futures as cf
+
+    def drain(p):
+        try:
+            out, err = p.communicate(timeout=timeout)
+            return subprocess.CompletedProcess(p.args, p.returncode,
+                                               out, err)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            return subprocess.CompletedProcess(p.args, -9, out, err)
+
+    with cf.ThreadPoolExecutor(max_workers=nproc) as pool:
+        results = list(pool.map(drain, procs))
+    failed = any(r.returncode != 0 for r in results)
+    if failed:
+        msgs = []
+        for i, r in enumerate(results):
+            msgs.append(f"--- proc {i} rc={r.returncode}\n"
+                        f"stdout:\n{r.stdout[-2000:]}\n"
+                        f"stderr:\n{r.stderr[-2000:]}")
+        raise RuntimeError(f"launch of {command!r} failed:\n"
+                           + "\n".join(msgs))
+    return results
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="paddle_tpu.parallel.launch",
+                                description=__doc__)
+    p.add_argument("--nproc", type=int, required=True)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port (default: free local port)")
+    p.add_argument("--cpu_devices_per_proc", type=int, default=None)
+    p.add_argument("script", nargs=argparse.REMAINDER,
+                   help="script and its args")
+    args = p.parse_args(argv)
+    if not args.script:
+        p.error("missing script to launch")
+    results = launch(args.nproc, [sys.executable] + args.script,
+                     coordinator=args.coordinator,
+                     cpu_devices_per_proc=args.cpu_devices_per_proc)
+    for i, r in enumerate(results):
+        sys.stdout.write(r.stdout)
+        sys.stderr.write(r.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,89 @@
+"""Shared worker script for the multi-process distributed tests.
+
+The analog of the reference's dist_mnist.py / dist_se_resnext.py model
+files driven by TestDistBase (test_dist_base.py:35,341): every process
+runs this same script; the parent compares the losses each process prints.
+
+Phases:
+1. bootstrap: paddle_tpu.parallel.distributed.init_distributed (the
+   gen_nccl_id capability) from PTPU_* env;
+2. collective sanity: global psum over every device in the world;
+3. training: 3 MeshTrainer steps of an MLP on a dp mesh spanning both
+   processes, global batch assembled from per-process local shards.
+
+Prints ONE json line: {"proc":, "nprocs":, "ndev":, "psum":, "losses":}.
+"""
+
+import json
+import os
+import sys
+
+# CPU platform must win over the sitecustomize TPU pin, before jax import
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.metrics import accuracy
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import MeshConfig, MeshTrainer, make_mesh
+    from paddle_tpu.parallel.distributed import (
+        init_distributed, process_count, process_index)
+
+    init_distributed()
+    nprocs = process_count()
+    proc = process_index()
+    ndev = jax.device_count()
+
+    # -- phase 2: global collective --------------------------------------
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    mesh = make_mesh(MeshConfig(dp=ndev))
+    sh = NamedSharding(mesh, P("dp"))
+    local = np.full((len(jax.local_devices()),), float(proc + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(sh, local)
+    psum = float(jax.jit(jnp.sum)(arr))
+
+    # -- phase 3: 2-process data-parallel training -----------------------
+    model = MLP(hidden=(16,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y),
+        metrics={"acc": accuracy})
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh)
+
+    gbs = 8 * ndev
+    rs = np.random.RandomState(0)              # same on every process
+    gx = rs.randn(gbs, 6).astype(np.float32)
+    gy = rs.randint(0, 4, gbs).astype(np.int64)
+
+    ts = trainer.init_state(jnp.zeros((gbs, 6)))
+
+    # per-process local slice of the global batch (DataFeeder splitting
+    # capability): rows are laid out in device order
+    bsh = NamedSharding(mesh, P("dp"))
+    rows_per_proc = gbs // nprocs
+    lo = proc * rows_per_proc
+    x = jax.make_array_from_process_local_data(
+        bsh, gx[lo:lo + rows_per_proc])
+    y = jax.make_array_from_process_local_data(
+        bsh, gy[lo:lo + rows_per_proc])
+
+    losses = []
+    for i in range(3):
+        ts, fetches = trainer.train_step(ts, (x, y), rng=jax.random.key(i))
+        losses.append(float(fetches["loss"]))
+
+    print(json.dumps({"proc": proc, "nprocs": nprocs, "ndev": ndev,
+                      "psum": psum, "losses": losses}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
