@@ -17,6 +17,13 @@ Capability-equivalent of PaddlePaddle Fluid ~1.2 (the reference at
 - `paddle_tpu.metrics` — metric ops (≈ fluid.metrics, operators/metrics/)
 - `paddle_tpu.kernels` — Pallas TPU kernels (≈ operators/jit, fused ops)
 - `paddle_tpu.profiler` — tracing/timeline (≈ platform/profiler)
+- `paddle_tpu.recordio` — chunked record file format, native C++ fast path
+  (≈ paddle/fluid/recordio)
+- `paddle_tpu.serving` — C++ serving shim over exported models (≈
+  inference/api/paddle_api.h)
+- `paddle_tpu.benchmark` — model-zoo benchmark harness with MFU (≈
+  benchmark/fluid/fluid_benchmark.py)
+- `paddle_tpu.testing` — numeric-gradient OpTest harness (≈ op_test.py)
 """
 
 from paddle_tpu.utils.flags import FLAGS, get_flags, set_flags
@@ -36,7 +43,7 @@ def __getattr__(name):
     # keep base import light.
     import importlib
     if name in ("data", "io", "metrics", "models", "parallel", "kernels",
-                "profiler", "serving"):
+                "profiler", "serving", "recordio", "benchmark", "testing"):
         try:
             return importlib.import_module(f"paddle_tpu.{name}")
         except ModuleNotFoundError as e:
