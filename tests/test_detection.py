@@ -1,0 +1,199 @@
+"""Detection op tests vs numpy references (the OpTest pattern for
+operators/detection/: check_output against hand-computed expectations,
+test_iou_similarity_op.py / test_multiclass_nms_op.py /
+test_bipartite_match_op.py shapes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops.detection as D
+
+
+BOXES = np.array([[0, 0, 10, 10],
+                  [5, 5, 15, 15],
+                  [20, 20, 30, 30],
+                  [0, 0, 10, 10]], np.float32)
+
+
+def test_iou_similarity():
+    iou = np.asarray(D.iou_similarity(jnp.asarray(BOXES),
+                                      jnp.asarray(BOXES)))
+    assert iou.shape == (4, 4)
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    # overlap of box0 and box1: inter 25, union 175
+    assert iou[0, 1] == pytest.approx(25.0 / 175.0, rel=1e-5)
+    assert iou[0, 2] == 0.0
+    assert iou[0, 3] == pytest.approx(1.0)
+
+
+def test_box_coder_roundtrip():
+    priors = jnp.asarray(BOXES)
+    var = jnp.asarray([0.1, 0.1, 0.2, 0.2])
+    gt = jnp.asarray([[2, 2, 9, 9], [18, 19, 31, 33]], np.float32)
+    enc = D.box_coder(priors, var, gt, "encode")      # [2, 4, 4]
+    assert enc.shape == (2, 4, 4)
+    # decode each gt against each prior must return the gt box
+    dec = D.box_coder(priors, var, enc, "decode")
+    for i in range(2):
+        for j in range(4):
+            np.testing.assert_allclose(np.asarray(dec[i, j]),
+                                       np.asarray(gt[i]), atol=1e-4)
+
+
+def test_box_clip():
+    out = np.asarray(D.box_clip(jnp.asarray([[-5, -5, 50, 8]], np.float32),
+                                (20, 40)))
+    np.testing.assert_allclose(out[0], [0, 0, 39, 8])
+
+
+def test_prior_box():
+    boxes, var = D.prior_box((2, 2), (100, 100), min_sizes=[30],
+                             max_sizes=[60], aspect_ratios=[2.0])
+    # priors per cell: 1 (ar=1,min) + 2 (ar=2 + flip) + 1 (max) = 4
+    assert boxes.shape == (2, 2, 4, 4) and var.shape == boxes.shape
+    b = np.asarray(boxes)
+    # first cell center is (25, 25)/100; ar=1 min_size box is 30x30
+    np.testing.assert_allclose(b[0, 0, 0], [0.10, 0.10, 0.40, 0.40],
+                               atol=1e-6)
+    # max-size prior: sqrt(30*60) side
+    side = np.sqrt(30 * 60) / 100
+    np.testing.assert_allclose(b[0, 0, 3],
+                               [0.25 - side / 2, 0.25 - side / 2,
+                                0.25 + side / 2, 0.25 + side / 2], atol=1e-6)
+
+
+def test_density_prior_box():
+    boxes, _ = D.density_prior_box((2, 2), (32, 32), fixed_sizes=[8.0],
+                                   fixed_ratios=[1.0], densities=[2])
+    assert boxes.shape == (2, 2, 4, 4)   # 2x2 sub-grid per cell
+    centers = (np.asarray(boxes)[0, 0, :, :2]
+               + np.asarray(boxes)[0, 0, :, 2:]) / 2
+    assert len(np.unique(centers.round(4), axis=0)) == 4
+
+
+def test_anchor_generator():
+    anchors, var = D.anchor_generator((3, 4), anchor_sizes=[32, 64],
+                                      aspect_ratios=[0.5, 1.0],
+                                      stride=(16, 16))
+    assert anchors.shape == (3, 4, 4, 4)
+    a = np.asarray(anchors)
+    # all anchors of cell (0,0) centered at (8, 8)
+    centers = (a[0, 0, :, :2] + a[0, 0, :, 2:]) / 2
+    np.testing.assert_allclose(centers, 8.0, atol=1e-4)
+    # ar=1 anchors are square
+    w = a[0, 0, 2, 2] - a[0, 0, 2, 0]
+    h = a[0, 0, 2, 3] - a[0, 0, 2, 1]
+    assert w == pytest.approx(h, rel=1e-5)
+
+
+def test_bipartite_match():
+    sim = jnp.asarray([[0.9, 0.1, 0.0],
+                       [0.8, 0.7, 0.2]], np.float32)
+    match, dist = D.bipartite_match(sim)
+    # greedy: (0,0)=0.9 first, then (1,1)=0.7
+    np.testing.assert_array_equal(np.asarray(match), [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(dist), [0.9, 0.7, 0.0], atol=1e-6)
+
+
+def test_target_assign():
+    x = jnp.asarray([[1., 2.], [3., 4.]])
+    out, w = D.target_assign(x, jnp.asarray([1, -1, 0], jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), [[3, 4], [0, 0], [1, 2]])
+    np.testing.assert_allclose(np.asarray(w), [1, 0, 1])
+
+
+def test_mine_hard_examples():
+    loss = jnp.asarray([5.0, 1.0, 4.0, 3.0, 2.0])
+    match = jnp.asarray([0, -1, -1, -1, -1], jnp.int32)  # 1 positive
+    mask = np.asarray(D.mine_hard_examples(loss, match, neg_pos_ratio=2.0))
+    # top-2-loss negatives: indices 2 (4.0) and 3 (3.0)
+    np.testing.assert_array_equal(mask, [False, False, True, True, False])
+
+
+def test_nms():
+    scores = jnp.asarray([0.9, 0.8, 0.7, 0.95], np.float32)
+    idx, ok = D.nms(jnp.asarray(BOXES), scores, iou_threshold=0.1,
+                    max_output=4)
+    idx, ok = np.asarray(idx), np.asarray(ok)
+    # box3 (0.95) wins, suppresses identical box0 and overlapping box1;
+    # box2 survives
+    assert list(idx[ok]) == [3, 2]
+
+
+def test_nms_jit_static_shape():
+    f = jax.jit(lambda b, s: D.nms(b, s, 0.5, max_output=3))
+    idx, ok = f(jnp.asarray(BOXES), jnp.asarray([0.5, 0.6, 0.7, 0.4]))
+    assert idx.shape == (3,) and ok.shape == (3,)
+
+
+def test_multiclass_nms():
+    boxes = jnp.asarray(BOXES)
+    scores = jnp.asarray([
+        [0.9, 0.9, 0.9, 0.9],     # class 0 = background, dropped
+        [0.8, 0.2, 0.7, 0.1],
+        [0.1, 0.6, 0.05, 0.0],
+    ], np.float32)
+    out, count = D.multiclass_nms(boxes, scores, score_threshold=0.05,
+                                  nms_threshold=0.3, keep_top_k=10)
+    out, count = np.asarray(out), int(count)
+    assert out.shape == (10, 6)
+    valid = out[:count]
+    assert count >= 2
+    assert valid[0][0] in (1, 2) and valid[0][1] == pytest.approx(0.8)
+    assert np.all(out[count:, 0] == -1)
+
+
+def test_roi_align_constant_field():
+    """On a constant feature map every roi bin must equal the constant."""
+    feat = jnp.full((16, 16, 3), 2.5)
+    rois = jnp.asarray([[0, 0, 8, 8], [4, 4, 12, 15]], np.float32)
+    out = D.roi_align(feat, rois, (4, 4))
+    assert out.shape == (2, 4, 4, 3)
+    np.testing.assert_allclose(np.asarray(out), 2.5, atol=1e-5)
+
+
+def test_roi_align_gradient_field():
+    """On a linear ramp f(x,y)=x, bin centers recover the x coordinate."""
+    xs = jnp.broadcast_to(jnp.arange(16.0)[None, :, None], (16, 16, 1))
+    rois = jnp.asarray([[2, 2, 10, 10]], np.float32)
+    out = np.asarray(D.roi_align(xs, rois, (4, 4), sampling_ratio=1))
+    bin_w = 8.0 / 4
+    expect_x = 2 + (np.arange(4) + 0.5) * bin_w
+    np.testing.assert_allclose(out[0, 0, :, 0], expect_x, atol=0.51)
+    # each row identical (f doesn't depend on y)
+    np.testing.assert_allclose(out[0, 0], out[0, 3], atol=1e-5)
+
+
+def test_roi_pool_max():
+    feat = jnp.zeros((8, 8, 1)).at[2, 3, 0].set(7.0)
+    rois = jnp.asarray([[0, 0, 7, 7]], np.float32)
+    out = np.asarray(D.roi_pool(feat, rois, (2, 2)))
+    assert out.max() == pytest.approx(7.0)
+
+
+def test_generate_proposals():
+    anchors, var = D.anchor_generator((4, 4), [16], [1.0], (8, 8))
+    a = anchors.reshape(-1, 4)
+    v = var.reshape(-1, 4)
+    rs = np.random.RandomState(0)
+    scores = jnp.asarray(rs.rand(16).astype(np.float32))
+    deltas = jnp.asarray(rs.randn(16, 4).astype(np.float32) * 0.1)
+    rois, rscores, valid = D.generate_proposals(
+        scores, deltas, a, v, (32, 32), pre_nms_top_n=16,
+        post_nms_top_n=8, nms_threshold=0.7)
+    rois, valid = np.asarray(rois), np.asarray(valid)
+    assert rois.shape == (8, 4)
+    assert valid.any()
+    got = rois[valid]
+    assert np.all(got[:, 0] >= 0) and np.all(got[:, 2] <= 31)
+    assert np.all(got[:, 2] >= got[:, 0])
+
+
+def test_polygon_box_transform():
+    x = jnp.zeros((1, 8, 2, 2))
+    out = np.asarray(D.polygon_box_transform(x))
+    # zero offsets -> pure grid coords: even channels 4*col, odd 4*row
+    np.testing.assert_allclose(out[0, 0], [[0, 4], [0, 4]])
+    np.testing.assert_allclose(out[0, 1], [[0, 0], [4, 4]])
