@@ -43,7 +43,8 @@ def __getattr__(name):
     # keep base import light.
     import importlib
     if name in ("data", "io", "metrics", "models", "parallel", "kernels",
-                "profiler", "serving", "recordio", "benchmark", "testing"):
+                "profiler", "serving", "recordio", "benchmark", "testing",
+                "quant"):
         try:
             return importlib.import_module(f"paddle_tpu.{name}")
         except ModuleNotFoundError as e:

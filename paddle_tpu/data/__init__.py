@@ -1,4 +1,4 @@
-from paddle_tpu.data import bucketing, readers, datasets
+from paddle_tpu.data import bucketing, common, datasets, readers, transforms
 from paddle_tpu.data.readers import (
     batch, buffered, cache, chain, compose, firstn, map_readers, shuffle,
     xmap_readers,

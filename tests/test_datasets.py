@@ -1,0 +1,223 @@
+"""Dataset breadth tests (reference python/paddle/dataset/tests/): every
+dataset family yields the documented row shapes; file-format parsers are
+exercised against synthetic files written in the real formats."""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import common, datasets, transforms
+from paddle_tpu.utils.flags import FLAGS
+
+
+def _first(reader, n=3):
+    it = reader()
+    return [next(it) for _ in range(n)]
+
+
+def test_mnist_synthetic_rows():
+    for img, lbl in _first(datasets.mnist_train()):
+        assert img.shape == (28, 28, 1) and img.dtype == np.float32
+        assert 0 <= int(lbl) < 10
+
+
+def test_cifar_synthetic_rows():
+    for img, lbl in _first(datasets.cifar10_train()):
+        assert img.shape == (32, 32, 3)
+    for img, lbl in _first(datasets.cifar100_train()):
+        assert 0 <= int(lbl) < 100
+
+
+def test_movielens_rows():
+    for u, g, a, o, m, genres, r in _first(datasets.movielens_train()):
+        assert genres.shape == (18,)
+        assert 1.0 <= float(r) <= 5.0
+        assert int(g) in (0, 1)
+
+
+def test_conll05_rows():
+    for words, mark, n, labels in _first(datasets.conll05_train()):
+        assert words.shape == labels.shape == mark.shape
+        assert int(mark.sum()) == 1          # one predicate
+        assert int(n) <= words.shape[0]
+        assert np.all(labels[int(n):] == 0)
+
+
+def test_voc2012_rows():
+    for img, boxes, labels, nb in _first(datasets.voc2012_train(
+            image_size=64)):
+        assert img.shape == (64, 64, 3)
+        assert boxes.shape == (8, 4) and labels.shape == (8,)
+        b = boxes[:int(nb)]
+        assert np.all(b[:, 2] >= b[:, 0]) and np.all(b <= 1.0)
+
+
+def test_mq2007_rows():
+    for feats, rel in _first(datasets.mq2007_train()):
+        assert feats.shape == (16, 46)
+        assert rel.shape == (16,) and set(np.unique(rel)) <= {0, 1, 2}
+
+
+def test_imikolov_ngram_rows():
+    for ctx, nxt in _first(datasets.imikolov_ngram_train(context=4)):
+        assert ctx.shape == (4,) and np.isscalar(int(nxt))
+
+
+def test_mnist_idx_file_parser(tmp_path, monkeypatch):
+    """Write real idx-format files and check the parser path engages."""
+    d = tmp_path / "mnist"
+    d.mkdir()
+    images = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+    labels = np.array([3, 7], np.uint8)
+    with gzip.open(d / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 2, 28, 28) + images.tobytes())
+    with gzip.open(d / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, 2) + labels.tobytes())
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows = list(datasets.mnist_train()())
+    assert len(rows) == 2
+    assert int(rows[0][1]) == 3 and int(rows[1][1]) == 7
+    assert rows[0][0].shape == (28, 28, 1)
+
+
+def test_cifar_pickle_tar_parser(tmp_path, monkeypatch):
+    d = tmp_path / "cifar"
+    d.mkdir()
+    data = np.random.RandomState(0).randint(
+        0, 256, (4, 3072)).astype(np.uint8)
+    batch = {b"data": data, b"labels": [0, 1, 2, 3]}
+    inner = tmp_path / "data_batch_1"
+    with open(inner, "wb") as f:
+        pickle.dump(batch, f)
+    with tarfile.open(d / "cifar-10-python.tar.gz", "w:gz") as tf:
+        tf.add(inner, arcname="cifar-10-batches-py/data_batch_1")
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows = list(datasets.cifar10_train()())
+    assert len(rows) == 4
+    assert rows[0][0].shape == (32, 32, 3)
+    assert [int(r[1]) for r in rows] == [0, 1, 2, 3]
+
+
+def test_cifar100_pickle_tar_parser(tmp_path, monkeypatch):
+    """cifar-100 members are named 'train'/'test' (no digits, no 'batch')
+    — the filter must still find them and use fine_labels."""
+    d = tmp_path / "cifar"
+    d.mkdir()
+    data = np.random.RandomState(0).randint(
+        0, 256, (3, 3072)).astype(np.uint8)
+    batch = {b"data": data, b"fine_labels": [10, 20, 99],
+             b"coarse_labels": [1, 2, 3]}
+    inner = tmp_path / "train"
+    with open(inner, "wb") as f:
+        pickle.dump(batch, f)
+    with tarfile.open(d / "cifar-100-python.tar.gz", "w:gz") as tf:
+        tf.add(inner, arcname="cifar-100-python/train")
+        meta = tmp_path / "meta"
+        meta.write_bytes(pickle.dumps({b"fine_label_names": []}))
+        tf.add(meta, arcname="cifar-100-python/meta")
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows = list(datasets.cifar100_train()())
+    assert len(rows) == 3
+    assert [int(r[1]) for r in rows] == [10, 20, 99]
+
+
+def test_imikolov_ngram_count_honored():
+    rows = list(datasets.imikolov_ngram_train(synthetic_n=100)())
+    assert len(rows) == 100
+
+
+def test_housing_file_parser(tmp_path, monkeypatch):
+    d = tmp_path / "uci_housing"
+    d.mkdir()
+    rs = np.random.RandomState(0)
+    rows = np.c_[rs.randn(10, 13), rs.rand(10, 1) * 50]
+    np.savetxt(d / "housing.data", rows)
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    train = list(datasets.uci_housing_train()())
+    test = list(datasets.uci_housing_test()())
+    assert len(train) == 8 and len(test) == 2       # 80/20 split
+    assert train[0][0].shape == (13,) and train[0][1].shape == (1,)
+
+
+def test_movielens_file_parser(tmp_path, monkeypatch):
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text("1::F::25::10::12345\n2::M::1::3::54321\n")
+    (d / "movies.dat").write_text(
+        "10::Toy Story (1995)::Animation|Comedy\n20::Heat (1995)::Action\n")
+    (d / "ratings.dat").write_text(
+        "1::10::5::978300760\n2::20::3::978302109\n")
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows = list(datasets.movielens_train()())
+    assert len(rows) == 2
+    u, g, a, o, m, genres, r = rows[0]
+    assert int(u) == 1 and int(g) == 1 and float(r) == 5.0
+    assert genres[2] == 1.0 and genres[4] == 1.0    # Animation, Comedy
+
+
+# --------------------------------------------------------------- transforms
+
+def test_simple_transform_shapes():
+    img = np.random.RandomState(0).rand(100, 80, 3).astype(np.float32)
+    out = transforms.simple_transform(
+        img, 64, 56, is_train=True, rng=np.random.RandomState(1))
+    assert out.shape == (56, 56, 3)
+    out = transforms.simple_transform(img, 64, 56, is_train=False)
+    assert out.shape == (56, 56, 3)
+
+
+def test_resize_short_keeps_aspect():
+    img = np.zeros((100, 50, 3), np.float32)
+    out = transforms.resize_short(img, 25)
+    assert out.shape == (50, 25, 3)
+
+
+def test_center_crop_and_flip():
+    img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+    c = transforms.center_crop(img, 2)
+    assert c.shape == (2, 2, 1)
+    f = transforms.left_right_flip(img)
+    assert f[0, 0, 0] == img[0, -1, 0]
+
+
+def test_to_chw():
+    assert transforms.to_chw(np.zeros((4, 5, 3))).shape == (3, 4, 5)
+
+
+# ------------------------------------------------------------------- common
+
+def test_md5file(tmp_path):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"hello world")
+    assert common.md5file(str(p)) == "5eb63bbbe01eeed093cb22bb8f5acdc3"
+
+
+def test_download_verifies_cache(tmp_path, monkeypatch):
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no\\s+network egress"):
+        common.download("http://x/y.tgz", "mod")
+    d = tmp_path / "mod"
+    d.mkdir()
+    (d / "y.tgz").write_bytes(b"data")
+    path = common.download("http://x/y.tgz", "mod")
+    assert path.endswith("y.tgz")
+    with pytest.raises(IOError, match="md5"):
+        common.download("http://x/y.tgz", "mod", md5sum="0" * 32)
+
+
+def test_split_and_cluster_files_reader(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    files = common.split(lambda: iter(range(10)), 3,
+                         suffix="chunk-%05d.pickle")
+    assert len(files) == 4                           # 3+3+3+1
+    r0 = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"),
+                                     trainer_count=2, trainer_id=0)
+    r1 = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"),
+                                     trainer_count=2, trainer_id=1)
+    all_items = sorted(list(r0()) + list(r1()))
+    assert all_items == list(range(10))              # disjoint, complete

@@ -1,0 +1,155 @@
+"""Quantization tests (reference test_quantization_pass.py: transform +
+freeze round-trips; contrib int8 accuracy-preservation checks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.quant as Q
+from paddle_tpu.core.module import STATE
+from paddle_tpu.nn.layers import Conv2D, Linear
+
+
+RS = np.random.RandomState(0)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = jnp.asarray(RS.randn(16).astype(np.float32))
+    scale = jnp.max(jnp.abs(x))
+    q = Q.quantize(x, scale, 8)
+    assert float(jnp.max(jnp.abs(q))) <= 127
+    back = Q.dequantize(q, scale, 8)
+    assert float(jnp.max(jnp.abs(back - x))) <= float(scale) / 127 + 1e-6
+
+
+def test_fake_quant_abs_max_ste_gradient():
+    x = jnp.asarray(RS.randn(8).astype(np.float32))
+    g = jax.grad(lambda a: jnp.sum(Q.fake_quant_abs_max(a)[0] ** 2))(x)
+    # STE: grad flows as if identity -> close to 2*qdq(x) ~ 2x
+    assert np.all(np.isfinite(np.asarray(g)))
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(x), atol=0.1)
+
+
+def test_fake_quant_channel_scales():
+    w = jnp.asarray(RS.randn(4, 3).astype(np.float32)) * \
+        jnp.asarray([1.0, 10.0, 0.1])
+    qdq, scale = Q.fake_quant_channel_abs_max(w, 8, axis=-1)
+    assert scale.shape == (3,)
+    np.testing.assert_allclose(np.asarray(scale),
+                               np.abs(np.asarray(w)).max(0), rtol=1e-6)
+    # error bounded per channel by scale/127
+    err = np.abs(np.asarray(qdq - w))
+    assert np.all(err <= np.asarray(scale)[None, :] / 127 + 1e-6)
+
+
+def test_fake_quant_moving_average_updates():
+    x = jnp.ones((4,)) * 2.0
+    s0 = jnp.zeros(())
+    _, s1 = Q.fake_quant_moving_average(x, s0, update=True)
+    assert float(s1) == pytest.approx(2.0)        # first batch seeds the EMA
+    _, s2 = Q.fake_quant_moving_average(x * 2, s1, update=True)
+    assert float(s2) == pytest.approx(0.9 * 2.0 + 0.1 * 4.0)
+    _, s3 = Q.fake_quant_moving_average(x * 100, s2, update=False)
+    assert float(s3) == float(s2)                 # frozen at inference
+
+
+def test_int8_matmul_matches_float():
+    x = jnp.asarray(RS.randn(5, 16).astype(np.float32))
+    w = jnp.asarray(RS.randn(16, 8).astype(np.float32))
+    xs = jnp.max(jnp.abs(x))
+    ws = jnp.max(jnp.abs(w), axis=0)
+    out = Q.int8_matmul(x, w, xs, ws)
+    ref = x @ w
+    # int8 quantization error ~ 1% relative for random gaussians
+    assert float(jnp.max(jnp.abs(out - ref))) < 0.25
+    assert np.corrcoef(np.asarray(out).ravel(),
+                       np.asarray(ref).ravel())[0, 1] > 0.999
+
+
+def test_quantize_model_rewrites_tree():
+    from paddle_tpu.models import LeNet
+    m = LeNet(num_classes=4)
+    Q.quantize_model(m)
+    assert type(m.conv1) is Q.QuantConv2D
+    assert type(m.fc1) is Q.QuantLinear
+    assert m._children["conv1"] is m.conv1
+    assert m._children["fc1"] is m.fc1
+
+
+def test_qat_loads_float_checkpoint_and_trains():
+    """Param tree of the quantized model must match the float model
+    (the reference loads FP32 checkpoints into the QAT graph)."""
+    from paddle_tpu.models import MLP
+    from paddle_tpu.core.executor import Trainer, supervised_loss
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+
+    x = jnp.asarray(RS.randn(16, 6).astype(np.float32))
+    y = RS.randint(0, 3, 16)
+
+    fm = MLP(hidden=(8,), num_classes=3)
+    fv = fm.init(0, x)
+
+    qm = Q.quantize_model(MLP(hidden=(8,), num_classes=3))
+    qv = qm.init(0, x)
+    assert (jax.tree_util.tree_structure(fv["params"])
+            == jax.tree_util.tree_structure(qv["params"]))
+    # float weights drop straight in
+    qv = {"params": fv["params"], STATE: qv.get(STATE, {})}
+
+    tr = Trainer(qm, Adam(1e-2), supervised_loss(
+        lambda lg, yy: F.softmax_with_cross_entropy(lg, yy)))
+    ts = tr.init_state(x)
+    ts = type(ts)(fv["params"], ts.state, ts.opt_state, ts.step)
+    losses = []
+    for i in range(25):
+        ts, f = tr.train_step(ts, (x, jnp.asarray(y)), rng=jax.random.key(i))
+        losses.append(float(f["loss"]))
+    assert losses[-1] < losses[0]          # QAT trains through the STE
+    # activation scales were learned (nonzero state)
+    scales = [float(v) for k, v in _flat(ts.state) if "act_scale" in k]
+    assert scales and all(s > 0 for s in scales)
+
+
+def _flat(tree, prefix=""):
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from _flat(v, prefix + k + "/")
+        else:
+            yield prefix + k, v
+
+
+def test_calibrate_empty_batches_raises():
+    from paddle_tpu.models import MLP
+    m = MLP(hidden=(8,), num_classes=3)
+    v = m.init(0, jnp.zeros((2, 6)))
+    with pytest.raises(ValueError, match="no calibration batches"):
+        Q.calibrate(m, v, [])
+
+
+def test_ptq_calibrate_and_freeze():
+    from paddle_tpu.models import MLP
+    x = jnp.asarray(RS.randn(8, 6).astype(np.float32))
+    m = MLP(hidden=(8,), num_classes=3)
+    v = m.init(0, x)
+    float_out = m.apply(v, x)
+
+    qm, qv = Q.calibrate(m, v, [(x,)] * 4)
+    scales = [float(s) for k, s in _flat(qv[STATE]) if "act_scale" in k]
+    assert scales and all(s > 0 for s in scales)
+    q_out = qm.apply(qv, x)
+    # int8 fake-quant model stays close to the float model
+    assert float(jnp.max(jnp.abs(q_out - float_out))) < 0.2
+
+    # freeze weights to int8 storage: ~4x smaller, dequant close to float
+    qparams, wscales = Q.quantize_weights(v["params"])
+    # weights shrink 4x; small biases stay f32, so bound is model-relative
+    assert Q.quantized_nbytes(qparams) < 0.5 * Q.quantized_nbytes(v["params"])
+    back = Q.dequantize_weights(qparams, wscales)
+    flat_f = jax.tree_util.tree_leaves(v["params"])
+    flat_b = jax.tree_util.tree_leaves(back)
+    for a, b in zip(flat_f, flat_b):
+        # int8 round-trip error < 1% of the leaf's range (zeros exact)
+        assert float(jnp.max(jnp.abs(a - b))) <= float(
+            jnp.max(jnp.abs(a))) / 100 + 1e-9
