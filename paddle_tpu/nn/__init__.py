@@ -7,3 +7,4 @@ from paddle_tpu.nn.layers import (
 from paddle_tpu.nn.rnn import (
     BiRNN, GRUCell, LSTMCell, RNN, StackedLSTM,
 )
+from paddle_tpu.nn.sampled import NCE, HierarchicalSigmoid

@@ -52,12 +52,18 @@ class Linear(Module):
         in_features = x.shape[-1]
         w = cx.param("weight", (in_features, self.features),
                      self.kernel_init, self.param_dtype)
+        x, w = self._qtransform(cx, x, w)
         y = jnp.matmul(x.astype(self.dtype), w.astype(self.dtype))
         if self.use_bias:
             b = cx.param("bias", (self.features,), self.bias_init,
                          self.param_dtype)
             y = y + b.astype(self.dtype)
         return y
+
+    def _qtransform(self, cx: Context, x, w):
+        """Hook for input/weight transforms (quant.layers overrides this
+        with the fake-quant pair); identity in the float layer."""
+        return x, w
 
 
 class Conv2D(Module):
@@ -88,6 +94,7 @@ class Conv2D(Module):
         kh, kw = self.kernel_size
         w = cx.param("weight", (kh, kw, cin // self.groups, self.features),
                      self.kernel_init, self.param_dtype)
+        x, w = self._qtransform(cx, x, w)
         pad = self.padding
         if isinstance(pad, int):
             pad = [(pad, pad), (pad, pad)]
@@ -103,6 +110,10 @@ class Conv2D(Module):
                          self.param_dtype)
             y = y + b.astype(self.dtype)
         return y
+
+    def _qtransform(self, cx: Context, x, w):
+        """Hook for input/weight transforms (see Linear._qtransform)."""
+        return x, w
 
 
 class Conv2DTranspose(Module):

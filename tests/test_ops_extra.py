@@ -1,0 +1,435 @@
+"""Tests for the long-tail op library: lattice DPs (CRF/CTC), vision
+warps, sampled softmax, losses, tensor utils. Numpy references follow the
+reference OpTest expectations (test_linear_chain_crf_op.py,
+test_warpctc_op.py, test_grid_sampler_op.py, ...)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops.extras as E
+import paddle_tpu.ops.lattice as L
+from paddle_tpu.testing import check_grad
+
+RS = np.random.RandomState(0)
+
+
+# ------------------------------------------------------------------- CRF
+
+def _brute_force_crf(emis, trans, length):
+    """Enumerate all paths (tiny K, T)."""
+    k = emis.shape[1]
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    scores = {}
+    for path in itertools.product(range(k), repeat=length):
+        s = start[path[0]] + emis[0, path[0]] + stop[path[-1]]
+        for i in range(1, length):
+            s += pair[path[i - 1], path[i]] + emis[i, path[i]]
+        scores[path] = s
+    return scores
+
+
+def test_crf_forward_matches_enumeration():
+    k, t = 3, 4
+    emis = RS.randn(1, t, k).astype(np.float32)
+    trans = RS.randn(k + 2, k).astype(np.float32)
+    scores = _brute_force_crf(emis[0], trans, t)
+    want = np.logaddexp.reduce(list(scores.values()))
+    got = float(L.crf_forward(jnp.asarray(emis), jnp.asarray(trans))[0])
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_crf_decoding_matches_enumeration():
+    k, t = 3, 4
+    emis = RS.randn(2, t, k).astype(np.float32)
+    trans = RS.randn(k + 2, k).astype(np.float32)
+    tags, score = L.crf_decoding(jnp.asarray(emis), jnp.asarray(trans))
+    for bi in range(2):
+        scores = _brute_force_crf(emis[bi], trans, t)
+        best = max(scores, key=scores.get)
+        assert tuple(np.asarray(tags[bi])) == best
+        assert float(score[bi]) == pytest.approx(float(scores[best]),
+                                                 rel=1e-5)
+
+
+def test_crf_ragged_lengths():
+    k, t = 3, 5
+    emis = RS.randn(1, t, k).astype(np.float32)
+    trans = RS.randn(k + 2, k).astype(np.float32)
+    lens = jnp.asarray([3], jnp.int32)
+    got = float(L.crf_forward(jnp.asarray(emis), jnp.asarray(trans), lens)[0])
+    want = np.logaddexp.reduce(
+        list(_brute_force_crf(emis[0, :3], trans, 3).values()))
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_crf_nll_trains():
+    """CRF NLL decreases under gradient descent and decodes the truth."""
+    k, t, b = 4, 6, 8
+    emis = jnp.asarray(RS.randn(b, t, k).astype(np.float32))
+    tags = jnp.asarray(RS.randint(0, k, (b, t)), jnp.int32)
+    trans = jnp.asarray(0.01 * RS.randn(k + 2, k).astype(np.float32))
+
+    def loss(trans, emis):
+        return jnp.mean(L.linear_chain_crf(emis, tags, trans))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+    l0 = float(loss(trans, emis))
+    for _ in range(60):
+        gt, ge = g(trans, emis)
+        trans = trans - 0.5 * gt
+        emis = emis - 0.5 * ge
+    l1 = float(loss(trans, emis))
+    assert l1 < l0 * 0.2
+    dec, _ = L.crf_decoding(emis, trans)
+    assert float(jnp.mean((dec == tags).astype(jnp.float32))) > 0.95
+
+
+# ------------------------------------------------------------------- CTC
+
+def _brute_force_ctc(logp, labels, blank=0):
+    """Sum probability over all alignments (tiny T, V)."""
+    t, v = logp.shape
+    total = -np.inf
+    for path in itertools.product(range(v), repeat=t):
+        # collapse
+        out = []
+        prev = -1
+        for p in path:
+            if p != prev and p != blank:
+                if not (out and p == out[-1] and prev != blank):
+                    out.append(p)
+                elif prev == blank:
+                    out.append(p)
+            prev = p
+        # standard collapse: remove repeats then blanks
+        out2 = []
+        prev = None
+        for p in path:
+            if p != prev:
+                out2.append(p)
+            prev = p
+        out2 = [p for p in out2 if p != blank]
+        if out2 == list(labels):
+            total = np.logaddexp(total, sum(logp[i, path[i]]
+                                            for i in range(t)))
+    return -total
+
+
+def test_ctc_loss_matches_enumeration():
+    t, v = 4, 3
+    logits = RS.randn(1, t, v).astype(np.float32)
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+    labels = np.array([[1, 2]], np.int32)
+    got = float(L.ctc_loss(jnp.asarray(logp), jnp.asarray(labels))[0])
+    want = _brute_force_ctc(logp[0], [1, 2])
+    assert got == pytest.approx(want, rel=1e-5)
+
+
+def test_ctc_loss_trains_and_decodes():
+    t, v = 12, 5
+    labels = jnp.asarray([[1, 2, 3]], jnp.int32)
+    logits = jnp.asarray(0.01 * RS.randn(1, t, v).astype(np.float32))
+
+    def loss(lg):
+        return jnp.mean(L.ctc_loss(jax.nn.log_softmax(lg, -1), labels))
+
+    g = jax.jit(jax.grad(loss))
+    for _ in range(200):
+        logits = logits - 1.0 * g(logits)
+    assert float(loss(logits)) < 0.1
+    greedy = jnp.argmax(logits, -1)
+    aligned, n = L.ctc_align(greedy)
+    assert list(np.asarray(aligned[0, :int(n[0])])) == [1, 2, 3]
+
+
+def test_ctc_align():
+    toks = jnp.asarray([[0, 1, 1, 0, 2, 2, 3, 0]])
+    out, n = L.ctc_align(toks)
+    assert int(n[0]) == 3
+    np.testing.assert_array_equal(np.asarray(out[0, :3]), [1, 2, 3])
+    # ragged: length limits the input
+    out2, n2 = L.ctc_align(toks, jnp.asarray([4], jnp.int32))
+    assert int(n2[0]) == 1
+    np.testing.assert_array_equal(np.asarray(out2[0, :1]), [1])
+
+
+# ----------------------------------------------------------- vision warps
+
+def test_affine_grid_identity_and_sampler():
+    theta = jnp.asarray([[[1.0, 0, 0], [0, 1.0, 0]]])
+    grid = E.affine_grid(theta, (4, 6))
+    assert grid.shape == (1, 4, 6, 2)
+    x = jnp.asarray(RS.randn(1, 4, 6, 2).astype(np.float32))
+    y = E.grid_sampler(x, grid)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-5)
+
+
+def test_grid_sampler_shift_zero_pad():
+    # shift grid fully outside -> zeros
+    x = jnp.ones((1, 4, 4, 1))
+    grid = jnp.full((1, 4, 4, 2), 5.0)
+    y = E.grid_sampler(x, grid)
+    assert float(jnp.abs(y).sum()) == 0.0
+
+
+def test_shuffle_channel_roundtrip():
+    x = jnp.asarray(RS.randn(1, 2, 2, 6).astype(np.float32))
+    y = E.shuffle_channel(x, 2)
+    z = E.shuffle_channel(y, 3)       # inverse group count restores
+    np.testing.assert_allclose(np.asarray(z), np.asarray(x))
+
+
+def test_space_depth_roundtrip():
+    x = jnp.asarray(RS.randn(1, 4, 4, 3).astype(np.float32))
+    y = E.space_to_depth(x, 2)
+    assert y.shape == (1, 2, 2, 12)
+    z = E.depth_to_space(y, 2)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(x))
+
+
+def test_pool_with_index_and_unpool():
+    x = jnp.asarray(RS.randn(1, 4, 4, 2).astype(np.float32))
+    out, idx = E.max_pool2d_with_index(x, 2, 2)
+    np.testing.assert_allclose(np.asarray(out)[0, 0, 0, 0],
+                               np.asarray(x)[0, :2, :2, 0].max())
+    rec = E.max_unpool2d(out, idx, (4, 4))
+    assert rec.shape == x.shape
+    # unpooled values reappear at their argmax positions, zeros elsewhere
+    assert float(jnp.sum(rec != 0)) == out.size
+    np.testing.assert_allclose(float(jnp.max(rec)), float(jnp.max(x)))
+
+
+def test_spp_shapes():
+    x = jnp.asarray(RS.randn(2, 8, 8, 3).astype(np.float32))
+    out = E.spp(x, levels=(1, 2, 4))
+    assert out.shape == (2, (1 + 4 + 16) * 3)
+
+
+def test_im2sequence():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    seq = E.im2sequence(x, (2, 2), (2, 2))
+    assert seq.shape == (1, 4, 4)
+    np.testing.assert_allclose(np.asarray(seq[0, 0]), [0, 1, 4, 5])
+
+
+# ---------------------------------------------------------- params/losses
+
+def test_prelu_selu_grad():
+    check_grad(lambda x, a: E.prelu(x, a),
+               RS.uniform(-2, 2, (3, 4)) + np.where(RS.rand(3, 4) > .5,
+                                                    .2, -.2),
+               np.array(0.25), name="prelu")
+    check_grad(E.selu, RS.uniform(.2, 2, (3, 4)), name="selu")
+
+
+def test_row_conv():
+    x = jnp.asarray(RS.randn(1, 5, 2).astype(np.float32))
+    w = jnp.asarray(RS.randn(3, 2).astype(np.float32))
+    y = E.row_conv(x, w)
+    want = sum(np.asarray(x[0, 2 + k]) * np.asarray(w[k]) for k in range(3))
+    np.testing.assert_allclose(np.asarray(y[0, 2]), want, rtol=1e-5)
+    # tail: future context beyond T contributes zero
+    want_last = np.asarray(x[0, 4]) * np.asarray(w[0])
+    np.testing.assert_allclose(np.asarray(y[0, 4]), want_last, rtol=1e-5)
+
+
+def test_conv_shift():
+    x = jnp.asarray(RS.randn(2, 8).astype(np.float32))
+    y = jnp.asarray(RS.randn(2, 3).astype(np.float32))
+    out = E.conv_shift(x, y)
+    b, i = 0, 2
+    want = sum(float(y[b, j]) * float(x[b, (i + j - 1) % 8])
+               for j in range(3))
+    assert float(out[b, i]) == pytest.approx(want, rel=1e-4)
+
+
+def test_bilinear_tensor_product():
+    x = jnp.asarray(RS.randn(2, 3).astype(np.float32))
+    y = jnp.asarray(RS.randn(2, 4).astype(np.float32))
+    w = jnp.asarray(RS.randn(5, 3, 4).astype(np.float32))
+    out = E.bilinear_tensor_product(x, y, w)
+    want = np.asarray(x[0]) @ np.asarray(w[2]) @ np.asarray(y[0])
+    assert float(out[0, 2]) == pytest.approx(float(want), rel=1e-4)
+
+
+def test_add_position_encoding_matches_transformer():
+    from paddle_tpu.models.transformer import sinusoid_position_encoding
+    x = jnp.zeros((1, 6, 8))
+    y = E.add_position_encoding(x)
+    np.testing.assert_allclose(
+        np.asarray(y[0]), np.asarray(sinusoid_position_encoding(6, 8)),
+        atol=1e-5)
+
+
+def test_multiplex():
+    a = jnp.asarray([[1.0, 1], [2, 2]])
+    b = jnp.asarray([[3.0, 3], [4, 4]])
+    out = E.multiplex(jnp.asarray([1, 0]), [a, b])
+    np.testing.assert_allclose(np.asarray(out), [[3, 3], [2, 2]])
+
+
+def test_losses_shapes_and_signs():
+    x = jnp.asarray(RS.randn(6).astype(np.float32))
+    y = jnp.asarray(RS.randint(0, 2, 6).astype(np.float32))
+    assert float(jnp.min(E.modified_huber_loss(x, y))) >= 0
+    assert float(jnp.min(E.rank_loss(x, -x, y))) >= 0
+    logits = jnp.asarray(RS.randn(4, 5).astype(np.float32))
+    lbl = jnp.asarray([0, 1, 2, 3])
+    assert E.bpr_loss(logits, lbl).shape == (4,)
+    assert float(jnp.min(E.teacher_student_sigmoid_loss(x, y))) >= 0
+
+
+def test_center_loss_pulls_to_centers():
+    feats = jnp.asarray(RS.randn(8, 4).astype(np.float32))
+    labels = jnp.asarray(RS.randint(0, 3, 8))
+    centers = jnp.zeros((3, 4))
+    loss, new_centers = E.center_loss(feats, labels, centers)
+    assert loss.shape == (8,)
+    # centers move toward the features' class means (alpha>0)
+    assert float(jnp.linalg.norm(new_centers)) > 0
+
+
+def test_mean_iou_perfect_and_partial():
+    pred = jnp.asarray([[0, 1], [2, 2]])
+    assert float(E.mean_iou(pred, pred, 3)) == pytest.approx(1.0)
+    lbl = jnp.asarray([[0, 1], [2, 0]])
+    v = float(E.mean_iou(pred, lbl, 3))
+    assert 0 < v < 1
+
+
+def test_npair_loss_positive():
+    a = jnp.asarray(RS.randn(6, 4).astype(np.float32))
+    p = jnp.asarray(RS.randn(6, 4).astype(np.float32))
+    lbl = jnp.asarray([0, 0, 1, 1, 2, 2])
+    assert float(E.npair_loss(a, p, lbl)) > 0
+
+
+# --------------------------------------------------------------- sampling
+
+def test_sampling_id_distribution():
+    probs = jnp.asarray([[0.9, 0.1, 0.0]] * 512)
+    ids = E.sampling_id(jax.random.key(0), probs)
+    frac = float(jnp.mean((ids == 0).astype(jnp.float32)))
+    assert frac > 0.8
+    assert float(jnp.max(ids)) <= 1          # class 2 has zero prob
+
+
+def test_random_ops():
+    r = jax.random.key(0)
+    u = E.uniform_random(r, (1000,), -2, 2)
+    assert -2 <= float(u.min()) and float(u.max()) <= 2
+    g = E.truncated_gaussian_random(r, (1000,), std=2.0)
+    assert float(jnp.max(jnp.abs(g))) <= 4.0 + 1e-5
+
+
+def test_hash_embedding_ids():
+    ids = jnp.asarray([3, 17, 3, 99])
+    h = E.hash_embedding_ids(ids, mod=1000, num_hash=2)
+    assert h.shape == (4, 2)
+    assert np.all(np.asarray(h) >= 0) and np.all(np.asarray(h) < 1000)
+    np.testing.assert_array_equal(np.asarray(h[0]), np.asarray(h[2]))
+
+
+# ----------------------------------------------------------- tensor utils
+
+def test_tensor_utils():
+    x = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    np.testing.assert_allclose(
+        np.asarray(E.crop(x, (0, 1, 1), (2, 2, 2)))[0, 0], [5, 6])
+    assert E.pad2d(jnp.zeros((1, 2, 2, 1)), [1, 1, 2, 2]).shape == \
+        (1, 4, 6, 1)
+    y = E.pad_constant_like(x, jnp.ones((1, 2, 2)), 7.0)
+    assert y.shape == x.shape and float(y[1, 2, 3]) == 7.0
+    parts = E.unstack(x, 1)
+    assert len(parts) == 3 and parts[0].shape == (2, 4)
+    assert E.flatten(x, 2).shape == (6, 4)
+    assert float(E.increment(jnp.asarray(1.0), 2.0)) == 3.0
+    f = E.fill_constant_batch_size_like(x, (9, 5), 2.5)
+    assert f.shape == (2, 5) and float(f[0, 0]) == 2.5
+    assert float(E.squared_l2_norm(jnp.asarray([3.0, 4.0]))) == 25.0
+
+
+def test_positive_negative_pair():
+    scores = jnp.asarray([0.9, 0.2, 0.8, 0.1])
+    labels = jnp.asarray([2.0, 1.0, 2.0, 0.0])
+    qids = jnp.asarray([0, 0, 1, 1])
+    pos, neg, neu = E.positive_negative_pair(scores, labels, qids)
+    assert int(pos) == 2 and int(neg) == 0 and int(neu) == 0
+
+
+# ------------------------------------------------------- sampled softmax
+
+def test_nce_trains_and_matches_full_softmax_ranking():
+    from paddle_tpu.nn.sampled import NCE
+    v, d, b = 50, 16, 64
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(b, d).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, v, b))
+    layer = NCE(v, num_neg=8)
+    variables = layer.init(0, x, labels)
+
+    def loss(params):
+        return jnp.mean(layer.apply(
+            {"params": params["params"]}, x, labels,
+            rngs=jax.random.key(7), training=True))
+
+    params = variables
+    g = jax.jit(jax.grad(lambda p: loss(p)))
+    l0 = float(loss(params))
+    for i in range(150):
+        grads = g(params)
+        params = jax.tree.map(lambda p_, g_: p_ - 0.3 * g_, params, grads)
+    assert float(loss(params)) < l0
+    # after training, the true class ranks high in the dense logits
+
+    class _Full(type(layer)):
+        def forward(self, cx, x):
+            return self.full_logits(cx, x)
+    full = _Full(v, num_neg=8)
+    object.__setattr__(full, "_name", layer._name)
+    logits = full.apply({"params": params["params"]}, x)
+    top5 = jnp.argsort(-logits, axis=1)[:, :20]
+    hit = jnp.mean(jnp.any(top5 == labels[:, None], axis=1)
+                   .astype(jnp.float32))
+    assert float(hit) > 0.5
+
+
+def test_hierarchical_sigmoid_is_normalized_and_trains():
+    from paddle_tpu.nn.sampled import HierarchicalSigmoid
+    v, d, b = 10, 8, 32
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(b, d).astype(np.float32))
+    labels = jnp.asarray(rs.randint(0, v, b))
+    layer = HierarchicalSigmoid(v)
+    variables = layer.init(0, x, labels)
+
+    class _Full(HierarchicalSigmoid):
+        def forward(self, cx, x):
+            return self.full_log_probs(cx, x)
+    full = _Full(v)
+    object.__setattr__(full, "_name", layer._name)
+    lp = full.apply(variables, x)
+    # leaf log-probs sum to 1: the tree factorization is a distribution
+    np.testing.assert_allclose(np.asarray(jnp.sum(jnp.exp(lp), axis=1)),
+                               1.0, rtol=1e-5)
+
+    def loss(params):
+        return jnp.mean(layer.apply(params, x, labels))
+
+    params = variables
+    g = jax.jit(jax.grad(loss))
+    l0 = float(loss(params))
+    for _ in range(100):
+        params = jax.tree.map(lambda p_, g_: p_ - 0.5 * g_, params,
+                              g(params))
+    assert float(loss(params)) < l0 * 0.5
+    # NLL equals dense -log p
+    lp2 = full.apply(params, x)
+    nll_dense = -jnp.take_along_axis(lp2, labels[:, None], 1)[:, 0]
+    nll_tree = layer.apply(params, x, labels)
+    np.testing.assert_allclose(np.asarray(nll_tree), np.asarray(nll_dense),
+                               rtol=1e-4)
