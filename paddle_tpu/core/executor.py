@@ -68,6 +68,14 @@ class TrainState:
     ≈ the reference's Scope contents for a training program: parameters,
     BN running stats (non-trainable state), optimizer accumulators
     (optimizer.py _create_accumulators) and the global step.
+
+    NOTE on `_step_hint`: trainers stamp returned states with a host-side
+    `_step_hint` int attribute that rides OUTSIDE the pytree — any
+    `jax.tree.map` over a TrainState builds a new instance and silently
+    drops it. That is safe (host_step_of falls back to one device_get and
+    trainers re-stamp on the next step) but costs one sync; the hint is a
+    logging optimisation only and nothing in the compiled step depends on
+    it.
     """
     params: Pytree
     state: Pytree          # non-trainable module state (BN stats, ...)

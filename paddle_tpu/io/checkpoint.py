@@ -384,6 +384,17 @@ def load_checkpoint(path: str, target: Optional[Pytree] = None,
         src.close()
 
 
+def read_metadata(path: str) -> Dict:
+    """Read a checkpoint's manifest metadata dict (without loading data).
+
+    Used to validate structural assumptions on restore, e.g.
+    ShardedEmbedding.validate_checkpoint guards against a num_embeddings
+    change silently misaligning padded table rows."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    return manifest.get("metadata", {}) or {}
+
+
 # Reference-compatible aliases (io.py:441 save_persistables / :657 load).
 save_persistables = save_checkpoint
 load_persistables = load_checkpoint

@@ -1,4 +1,5 @@
 from paddle_tpu.metrics.metrics import (
-    Accuracy, Auc, ChunkEvaluator, CompositeMetric, EditDistance, MetricBase,
-    Precision, Recall, accuracy, auc,
+    Accuracy, Auc, ChunkEvaluator, CompositeMetric, DetectionMAP,
+    EditDistance, MetricBase, Precision, PrecisionRecall, Recall, accuracy,
+    auc,
 )

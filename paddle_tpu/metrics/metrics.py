@@ -255,3 +255,143 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class PrecisionRecall(MetricBase):
+    """Multiclass streaming precision/recall/F1 (reference
+    operators/metrics/precision_recall_op.cc: accumulates per-class
+    TP/FP/FN and reports macro + micro averages)."""
+
+    def __init__(self, num_classes: int, name=None):
+        super().__init__(name)
+        self.num_classes = num_classes
+        self.reset()
+
+    def reset(self):
+        self.tp = np.zeros(self.num_classes, np.int64)
+        self.fp = np.zeros(self.num_classes, np.int64)
+        self.fn = np.zeros(self.num_classes, np.int64)
+
+    def update(self, preds, labels):
+        """preds: [N] predicted class ids (or [N, C] scores); labels [N]."""
+        preds = np.asarray(preds)
+        if preds.ndim == 2:
+            preds = preds.argmax(-1)
+        preds = preds.astype(int).reshape(-1)
+        labels = np.asarray(labels).astype(int).reshape(-1)
+        for c in range(self.num_classes):
+            self.tp[c] += int(np.sum((preds == c) & (labels == c)))
+            self.fp[c] += int(np.sum((preds == c) & (labels != c)))
+            self.fn[c] += int(np.sum((preds != c) & (labels == c)))
+
+    def eval(self):
+        """Returns dict with macro/micro precision, recall, f1."""
+        with np.errstate(divide="ignore", invalid="ignore"):
+            prec = np.where(self.tp + self.fp > 0,
+                            self.tp / np.maximum(self.tp + self.fp, 1), 0.0)
+            rec = np.where(self.tp + self.fn > 0,
+                           self.tp / np.maximum(self.tp + self.fn, 1), 0.0)
+        f1 = np.where(prec + rec > 0, 2 * prec * rec
+                      / np.maximum(prec + rec, 1e-12), 0.0)
+        tp, fp, fn = self.tp.sum(), self.fp.sum(), self.fn.sum()
+        micro_p = tp / max(tp + fp, 1)
+        micro_r = tp / max(tp + fn, 1)
+        micro_f = (2 * micro_p * micro_r / max(micro_p + micro_r, 1e-12)
+                   if micro_p + micro_r else 0.0)
+        return {"macro_precision": float(prec.mean()),
+                "macro_recall": float(rec.mean()),
+                "macro_f1": float(f1.mean()),
+                "micro_precision": float(micro_p),
+                "micro_recall": float(micro_r),
+                "micro_f1": float(micro_f)}
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference metrics.py:566
+    DetectionMAP + operators/detection_map_op.cc).
+
+    update() takes per-image detections [[label, score, x1, y1, x2, y2],
+    ...] and ground truth [[label, x1, y1, x2, y2], ...]; eval() returns
+    mAP over classes using 11-point or integral interpolation.
+    """
+
+    def __init__(self, overlap_threshold: float = 0.5,
+                 ap_version: str = "integral",
+                 evaluate_difficult: bool = False, name=None):
+        super().__init__(name)
+        assert ap_version in ("integral", "11point")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def reset(self):
+        # per class: list of (score, is_tp); and gt count
+        self._scored: Dict[int, list] = {}
+        self._npos: Dict[int, int] = {}
+
+    @staticmethod
+    def _iou(a, b):
+        ax1, ay1, ax2, ay2 = a
+        bx1, by1, bx2, by2 = b
+        iw = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+        ih = max(0.0, min(ay2, by2) - max(ay1, by1))
+        inter = iw * ih
+        ua = max((ax2 - ax1) * (ay2 - ay1), 0) + \
+            max((bx2 - bx1) * (by2 - by1), 0) - inter
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gts, difficult=None):
+        detections = [list(map(float, d)) for d in np.asarray(detections)
+                      .reshape(-1, 6)] if len(detections) else []
+        gts = [list(map(float, g)) for g in np.asarray(gts).reshape(-1, 5)] \
+            if len(gts) else []
+        difficult = ([bool(d) for d in difficult] if difficult is not None
+                     else [False] * len(gts))
+        for (glabel, *_), diff in zip(gts, difficult):
+            if self.evaluate_difficult or not diff:
+                self._npos[int(glabel)] = self._npos.get(int(glabel), 0) + 1
+        used = [False] * len(gts)
+        for label, score, x1, y1, x2, y2 in sorted(
+                detections, key=lambda d: -d[1]):
+            label = int(label)
+            if label < 0:
+                continue
+            best, best_j = 0.0, -1
+            for j, (glabel, gx1, gy1, gx2, gy2) in enumerate(gts):
+                if int(glabel) != label or used[j]:
+                    continue
+                ov = self._iou((x1, y1, x2, y2), (gx1, gy1, gx2, gy2))
+                if ov > best:
+                    best, best_j = ov, j
+            tp = best >= self.overlap_threshold and best_j >= 0
+            if tp and not (difficult[best_j] and not self.evaluate_difficult):
+                used[best_j] = True
+                self._scored.setdefault(label, []).append((score, 1))
+            elif tp:
+                pass  # difficult match: neither tp nor fp
+            else:
+                self._scored.setdefault(label, []).append((score, 0))
+
+    def eval(self):
+        aps = []
+        for label, npos in self._npos.items():
+            scored = sorted(self._scored.get(label, []), key=lambda s: -s[0])
+            if not scored or npos == 0:
+                aps.append(0.0)
+                continue
+            tps = np.cumsum([t for _, t in scored])
+            fps = np.cumsum([1 - t for _, t in scored])
+            rec = tps / npos
+            prec = tps / np.maximum(tps + fps, 1)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                    ap += p / 11
+            else:
+                # integral: sum precision deltas at each recall step
+                mrec = np.concatenate([[0.0], rec])
+                ap = float(np.sum((mrec[1:] - mrec[:-1]) * prec))
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0

@@ -147,6 +147,152 @@ class Conv2DTranspose(Module):
         return y
 
 
+def _triple(v) -> Tuple[int, int, int]:
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v, v)
+
+
+class Conv3D(Module):
+    """3-D convolution, NDHWC, kernel (kd, kh, kw, in/groups, out).
+
+    Reference: fluid.layers.conv3d (operators/conv_op.cc registers conv3d;
+    kernels conv_op.h). TPU-first: NDHWC layout so XLA tiles the contraction
+    onto the MXU exactly as for 2-D convs.
+    """
+
+    def __init__(self, features: int, kernel_size, stride=1, padding="SAME",
+                 dilation=1, groups: int = 1, use_bias: bool = True,
+                 kernel_init=None, bias_init=None, dtype=jnp.float32,
+                 param_dtype=jnp.float32):
+        super().__init__()
+        self.features = features
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.dilation = _triple(dilation)
+        self.padding = padding
+        self.groups = groups
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init or I.kaiming_normal
+        self.bias_init = bias_init or I.zeros
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+
+    def forward(self, cx: Context, x):
+        cin = x.shape[-1]
+        kd, kh, kw = self.kernel_size
+        w = cx.param("weight", (kd, kh, kw, cin // self.groups, self.features),
+                     self.kernel_init, self.param_dtype)
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad)] * 3
+        elif isinstance(pad, (tuple, list)) and isinstance(pad[0], int):
+            pad = [(p, p) for p in pad]
+        y = lax.conv_general_dilated(
+            x.astype(self.dtype), w.astype(self.dtype),
+            window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            b = cx.param("bias", (self.features,), self.bias_init,
+                         self.param_dtype)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+class Conv3DTranspose(Module):
+    """Transposed 3-D conv (reference conv3d_transpose,
+    operators/conv_transpose_op.cc). NDHWC."""
+
+    def __init__(self, features: int, kernel_size, stride=1, padding="SAME",
+                 use_bias: bool = True, kernel_init=None, dtype=jnp.float32,
+                 param_dtype=jnp.float32):
+        super().__init__()
+        self.features = features
+        self.kernel_size = _triple(kernel_size)
+        self.stride = _triple(stride)
+        self.padding = padding
+        self.use_bias = use_bias
+        self.kernel_init = kernel_init or I.glorot_uniform
+        self.dtype = dtype
+        self.param_dtype = param_dtype
+
+    def forward(self, cx: Context, x):
+        cin = x.shape[-1]
+        kd, kh, kw = self.kernel_size
+        w = cx.param("weight", (kd, kh, kw, cin, self.features),
+                     self.kernel_init, self.param_dtype)
+        y = lax.conv_transpose(
+            x.astype(self.dtype), w.astype(self.dtype),
+            strides=self.stride, padding=self.padding,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.use_bias:
+            b = cx.param("bias", (self.features,), I.zeros, self.param_dtype)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+def max_pool3d(x, window, stride=None, padding="VALID"):
+    """Reference pool3d(pool_type='max') (operators/pool_op.cc). NDHWC."""
+    wd, wh, ww = _triple(window)
+    sd, sh, sw = _triple(stride if stride is not None else window)
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, wd, wh, ww, 1),
+                             (1, sd, sh, sw, 1), padding)
+
+
+def avg_pool3d(x, window, stride=None, padding="VALID"):
+    """Reference pool3d(pool_type='avg'). NDHWC."""
+    wd, wh, ww = _triple(window)
+    sd, sh, sw = _triple(stride if stride is not None else window)
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, wd, wh, ww, 1),
+                               (1, sd, sh, sw, 1), padding)
+    return summed / (wd * wh * ww)
+
+
+def lrn(x, n: int = 5, k: float = 1.0, alpha: float = 1e-4,
+        beta: float = 0.75):
+    """Local response normalisation across channels (reference lrn op,
+    operators/lrn_op.cc). NHWC: window of `n` adjacent channels."""
+    sq = jnp.square(x.astype(jnp.float32))
+    half = n // 2
+    # channel-axis sliding-window sum via padded reduce_window
+    win = (1,) * (x.ndim - 1) + (n,)
+    strides = (1,) * x.ndim
+    pads = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+    denom = k + alpha * lax.reduce_window(sq, 0.0, lax.add, win, strides,
+                                          pads)
+    return (x.astype(jnp.float32) / jnp.power(denom, beta)).astype(x.dtype)
+
+
+class DataNorm(Module):
+    """Streaming feature normalisation without batch statistics coupling
+    (reference data_norm op, operators/data_norm_op.cc: normalises by
+    accumulated size/sum/squared-sum — used by CTR models where batch norm's
+    batch coupling hurts).
+
+    State: (count, sum, sumsq) accumulated per feature; output is
+    (x - mean) / std with means/stds from the running totals.
+    """
+
+    def __init__(self, epsilon: float = 1e-4, param_dtype=jnp.float32):
+        super().__init__()
+        self.epsilon = epsilon
+        self.param_dtype = param_dtype
+
+    def forward(self, cx: Context, x):
+        feat = x.shape[-1]
+        count = cx.state("count", (), I.ones, self.param_dtype)
+        total = cx.state("sum", (feat,), I.zeros, self.param_dtype)
+        sumsq = cx.state("sumsq", (feat,), I.ones, self.param_dtype)
+        mean = total / count
+        var = jnp.maximum(sumsq / count - jnp.square(mean), 0.0)
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        if cx.training:
+            xf = x.astype(jnp.float32).reshape(-1, feat)
+            cx.set_state("count", count + xf.shape[0])
+            cx.set_state("sum", total + jnp.sum(xf, axis=0))
+            cx.set_state("sumsq", sumsq + jnp.sum(jnp.square(xf), axis=0))
+        return y.astype(x.dtype)
+
+
 def max_pool2d(x, window, stride=None, padding="VALID"):
     """Reference fluid.layers.pool2d(pool_type='max'); NHWC."""
     wh, ww = _pair(window)
@@ -185,7 +331,7 @@ class BatchNorm(Module):
 
     def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
                  scale: bool = True, center: bool = True, axis: int = -1,
-                 dtype=jnp.float32, param_dtype=jnp.float32,
+                 dtype=None, param_dtype=jnp.float32,
                  axis_name: Optional[str] = None):
         super().__init__()
         self.momentum = momentum
@@ -235,14 +381,17 @@ class BatchNorm(Module):
         if self.center:
             b = cx.param("bias", (feat,), I.zeros, self.param_dtype)
             y = y + b.reshape(shape)
-        return y.astype(self.dtype)
+        # dtype=None: match the input dtype (stats stay fp32 above). A bf16
+        # activation stream stays bf16 end to end — upcasting here doubles
+        # HBM traffic on every norm, the main MFU sink found in round 2.
+        return y.astype(self.dtype or x.dtype)
 
 
 class LayerNorm(Module):
     """Reference fluid.layers.layer_norm (operators/layer_norm_op)."""
 
     def __init__(self, epsilon: float = 1e-5, scale: bool = True,
-                 center: bool = True, dtype=jnp.float32,
+                 center: bool = True, dtype=None,
                  param_dtype=jnp.float32):
         super().__init__()
         self.epsilon = epsilon
@@ -261,14 +410,14 @@ class LayerNorm(Module):
             y = y * cx.param("scale", (feat,), I.ones, self.param_dtype)
         if self.center:
             y = y + cx.param("bias", (feat,), I.zeros, self.param_dtype)
-        return y.astype(self.dtype)
+        return y.astype(self.dtype or x.dtype)
 
 
 class GroupNorm(Module):
     """Reference fluid.layers.group_norm (operators/group_norm_op). NHWC."""
 
     def __init__(self, groups: int = 32, epsilon: float = 1e-5,
-                 dtype=jnp.float32, param_dtype=jnp.float32):
+                 dtype=None, param_dtype=jnp.float32):
         super().__init__()
         self.groups = groups
         self.epsilon = epsilon
@@ -286,7 +435,7 @@ class GroupNorm(Module):
         y = ((xf - mean) * lax.rsqrt(var + self.epsilon)).reshape(orig)
         y = y * cx.param("scale", (feat,), I.ones, self.param_dtype)
         y = y + cx.param("bias", (feat,), I.zeros, self.param_dtype)
-        return y.astype(self.dtype)
+        return y.astype(self.dtype or x.dtype)
 
 
 class Dropout(Module):

@@ -28,20 +28,26 @@ from paddle_tpu.nn.layers import Linear
 
 
 class LSTMCell(Module):
-    """Standard LSTM cell (operators/math/lstm_compute: i,f,c,o gates)."""
+    """Standard LSTM cell (operators/math/lstm_compute: i,f,c,o gates).
+
+    `proj_size` adds a recurrent output projection (reference lstmp op,
+    operators/lstmp_op.cc): h is projected to proj_size before recurrence.
+    """
 
     def __init__(self, hidden: int, forget_bias: float = 1.0,
-                 dtype=jnp.float32):
+                 proj_size: int = 0, dtype=jnp.float32):
         super().__init__()
         self.hidden = hidden
         self.forget_bias = forget_bias
+        self.proj_size = proj_size
         self.dtype = dtype
 
     def forward(self, cx: Context, carry, x):
         h, c = carry
         d = x.shape[-1]
+        h_dim = self.proj_size or self.hidden
         wx = cx.param("wx", (d, 4 * self.hidden), I.glorot_uniform)
-        wh = cx.param("wh", (self.hidden, 4 * self.hidden), I.orthogonal())
+        wh = cx.param("wh", (h_dim, 4 * self.hidden), I.orthogonal())
         b = cx.param("bias", (4 * self.hidden,), I.zeros)
         z = (x.astype(self.dtype) @ wx.astype(self.dtype)
              + h.astype(self.dtype) @ wh.astype(self.dtype)
@@ -50,11 +56,15 @@ class LSTMCell(Module):
         new_c = (jax.nn.sigmoid(f + self.forget_bias) * c
                  + jax.nn.sigmoid(i) * jnp.tanh(g))
         new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        if self.proj_size:
+            wp = cx.param("wp", (self.hidden, self.proj_size),
+                          I.glorot_uniform)
+            new_h = new_h @ wp.astype(new_h.dtype)
         return (new_h, new_c), new_h
 
     def init_carry(self, batch: int):
-        z = jnp.zeros((batch, self.hidden), self.dtype)
-        return (z, z)
+        h = jnp.zeros((batch, self.proj_size or self.hidden), self.dtype)
+        return (h, jnp.zeros((batch, self.hidden), self.dtype))
 
 
 class GRUCell(Module):

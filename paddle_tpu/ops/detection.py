@@ -448,3 +448,334 @@ def generate_proposals(scores, deltas, anchors, variances, im_shape,
     safe = jnp.maximum(pick, 0)
     return (jnp.where(valid[:, None], jnp.take(boxes, safe, axis=0), 0),
             jnp.where(valid, jnp.take(s, safe), 0), valid)
+
+
+# ------------------------------------------------- training target assignment
+
+def encode_boxes_paired(priors, targets, box_normalized: bool = False):
+    """Row-wise box encoding: priors [K, 4] vs targets [K, 4] -> [K, 4]
+    deltas (the diagonal of box_coder's pairwise encode)."""
+    off = 0.0 if box_normalized else 1.0
+    pw = priors[:, 2] - priors[:, 0] + off
+    ph = priors[:, 3] - priors[:, 1] + off
+    pcx = priors[:, 0] + pw * 0.5
+    pcy = priors[:, 1] + ph * 0.5
+    tw = targets[:, 2] - targets[:, 0] + off
+    th = targets[:, 3] - targets[:, 1] + off
+    tcx = targets[:, 0] + tw * 0.5
+    tcy = targets[:, 1] + th * 0.5
+    return jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                      jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                      jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+
+def rpn_target_assign(anchors, gt_boxes, gt_valid, rng,
+                      num_samples: int = 256, fg_fraction: float = 0.5,
+                      positive_overlap: float = 0.7,
+                      negative_overlap: float = 0.3):
+    """RPN anchor labeling + subsampling (rpn_target_assign_op.cc).
+
+    anchors [A, 4]; gt_boxes [G, 4]; gt_valid [G] bool (padded gt rows
+    False). Returns (labels [A] int32: 1 fg / 0 bg / -1 ignore,
+    bbox_targets [A, 4] encoded deltas, inside_weights [A] = fg mask).
+
+    Anchors with IoU > positive_overlap (or the best anchor per gt) are
+    fg; IoU < negative_overlap bg; rest ignored. Random subsampling to
+    `num_samples` with `fg_fraction` fg uses rng-ranked selection — the
+    XLA-friendly analog of the reference's shuffle-and-truncate.
+    """
+    a = anchors.shape[0]
+    iou = iou_similarity(gt_boxes, anchors, box_normalized=False)  # [G, A]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=0)                 # [A]
+    best_iou = jnp.max(iou, axis=0)                   # [A]
+    # the best anchor for each (valid) gt is always fg; .max (not .set)
+    # so a padded gt row (argmax 0 on its zeroed IoU row) can never clear
+    # a valid gt's forced anchor
+    best_anchor = jnp.argmax(iou, axis=1)             # [G]
+    forced = jnp.zeros((a,), bool).at[best_anchor].max(gt_valid)
+    fg = forced | (best_iou >= positive_overlap)
+    bg = (~fg) & (best_iou < negative_overlap)
+
+    # rng-ranked subsampling: rank fg (resp. bg) candidates by random key,
+    # keep the first n_fg (resp. n_bg)
+    n_fg = jnp.minimum(int(num_samples * fg_fraction),
+                       jnp.sum(fg)).astype(jnp.int32)
+    r = jax.random.uniform(rng, (a,))
+    fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+    fg_keep = fg & (fg_rank < n_fg)
+    n_bg = jnp.minimum(num_samples - n_fg, jnp.sum(bg)).astype(jnp.int32)
+    bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+    bg_keep = bg & (bg_rank < n_bg)
+
+    labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1)).astype(
+        jnp.int32)
+    matched = jnp.take(gt_boxes, best_gt, axis=0)     # [A, 4]
+    targets = encode_boxes_paired(anchors, matched)
+    targets = jnp.where(fg_keep[:, None], targets, 0.0)
+    return labels, targets, fg_keep.astype(jnp.float32)
+
+
+def generate_proposal_labels(rois, gt_boxes, gt_classes, gt_valid, rng,
+                             batch_size_per_im: int = 128,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0):
+    """Sample RoIs + assign classification/regression targets for the
+    second stage (generate_proposal_labels_op.cc).
+
+    rois [R, 4]; gt_boxes [G, 4]; gt_classes [G] int; gt_valid [G] bool.
+    Returns fixed-size (sampled_rois [S, 4], labels [S] int32 (0 = bg, -1 =
+    pad), bbox_targets [S, 4], fg_mask [S] float) with S = batch_size_per_im.
+    """
+    iou = iou_similarity(gt_boxes, rois, box_normalized=False)   # [G, R]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=0)
+    best_iou = jnp.max(iou, axis=0)
+    fg = best_iou >= fg_thresh
+    bg = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo) & (~fg)
+
+    s = batch_size_per_im
+    n_fg = jnp.minimum(int(s * fg_fraction), jnp.sum(fg)).astype(jnp.int32)
+    r = jax.random.uniform(rng, (rois.shape[0],))
+    fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+    bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+    n_bg = jnp.minimum(s - n_fg, jnp.sum(bg)).astype(jnp.int32)
+    keep = (fg & (fg_rank < n_fg)) | (bg & (bg_rank < n_bg))
+    # order selected rois first (fg then bg), pad with zeros
+    sel_key = jnp.where(fg & (fg_rank < n_fg), fg_rank,
+                        jnp.where(bg & (bg_rank < n_bg),
+                                  s + bg_rank, 2 * s + 1e6))
+    order = jnp.argsort(sel_key)[:s]
+    sel_valid = jnp.take(keep, order)
+    out_rois = jnp.where(sel_valid[:, None],
+                         jnp.take(rois, order, axis=0), 0.0)
+    sel_fg = jnp.take(fg, order) & sel_valid
+    cls = jnp.take(jnp.take(gt_classes, best_gt), order)
+    labels = jnp.where(sel_fg, cls.astype(jnp.int32),
+                       jnp.where(sel_valid, 0, -1))
+    matched = jnp.take(jnp.take(gt_boxes, best_gt, axis=0), order, axis=0)
+    targets = encode_boxes_paired(out_rois, matched)
+    targets = jnp.where(sel_fg[:, None], targets, 0.0)
+    return out_rois, labels, targets, sel_fg.astype(jnp.float32)
+
+
+def generate_mask_labels(rois, fg_mask, roi_gt_index, gt_masks,
+                         resolution: int = 14):
+    """Crop+resize each fg RoI's matched instance mask to a fixed
+    [resolution, resolution] training target (generate_mask_labels_op.cc).
+
+    rois [S, 4]; fg_mask [S]; roi_gt_index [S] int (matched gt per roi);
+    gt_masks [G, Hm, Wm] float in image coords. Returns [S, res, res].
+    """
+    hm, wm = gt_masks.shape[1:]
+
+    def one(roi, gi, is_fg):
+        m = jnp.take(gt_masks, gi, axis=0)            # [Hm, Wm]
+        x1, y1, x2, y2 = roi
+        gy = y1 + (jnp.arange(resolution) + 0.5) / resolution * \
+            jnp.maximum(y2 - y1, 1.0)
+        gx = x1 + (jnp.arange(resolution) + 0.5) / resolution * \
+            jnp.maximum(x2 - x1, 1.0)
+        yi = jnp.clip(jnp.round(gy), 0, hm - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.round(gx), 0, wm - 1).astype(jnp.int32)
+        patch = m[yi][:, xi]
+        return jnp.where(is_fg, (patch > 0.5).astype(jnp.float32), 0.0)
+
+    return jax.vmap(one)(jnp.asarray(rois, jnp.float32),
+                         roi_gt_index.astype(jnp.int32), fg_mask > 0)
+
+
+# ------------------------------------------------------- RoI (tail variants)
+
+def psroi_pool(features, rois, output_size: Tuple[int, int],
+               spatial_scale: float = 1.0, sampling_ratio: int = 2):
+    """Position-sensitive RoI pooling (psroi_pool_op.cc): input channels
+    C = ph*pw*out_c; bin (i, j) average-pools only its own channel group.
+    features [H, W, ph*pw*out_c]; rois [R, 4] -> [R, ph, pw, out_c].
+
+    Samples each bin's own channel slice directly (sampling all ph*pw
+    groups and discarding all but one would do ph*pw times the work)."""
+    hh, ww, c = features.shape
+    ph, pw = output_size
+    out_c = c // (ph * pw)
+    sr = max(sampling_ratio, 1)
+    grouped = features.reshape(hh, ww, ph * pw, out_c)
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        bin_w = jnp.maximum(x2 - x1, 1.0) / pw
+        bin_h = jnp.maximum(y2 - y1, 1.0) / ph
+        # sample grid per bin: [ph, sr] x [pw, sr]
+        gy = y1 + (jnp.arange(ph)[:, None]
+                   + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_h
+        gx = x1 + (jnp.arange(pw)[:, None]
+                   + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_w
+        y0 = jnp.clip(jnp.floor(gy), 0, hh - 1)                    # [ph,sr]
+        x0 = jnp.clip(jnp.floor(gx), 0, ww - 1)                    # [pw,sr]
+        y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = jnp.clip(gy - y0, 0.0, 1.0)[:, None, :, None, None]
+        wx = jnp.clip(gx - x0, 0.0, 1.0)[None, :, None, :, None]
+        # gather only bin (i, j)'s channel group g = i*pw + j
+        bin_g = (jnp.arange(ph)[:, None] * pw
+                 + jnp.arange(pw)[None, :])[:, :, None, None]      # [ph,pw]
+
+        def g(yi, xi):   # -> [ph, pw, sr, sr, out_c]
+            return grouped[yi[:, None, :, None], xi[None, :, None, :],
+                           bin_g]
+        top = g(y0i, x0i) * (1 - wx) + g(y0i, x1i) * wx
+        bot = g(y1i, x0i) * (1 - wx) + g(y1i, x1i) * wx
+        vals = top * (1 - wy) + bot * wy
+        return vals.mean(axis=(2, 3))
+
+    return jax.vmap(one_roi)(jnp.asarray(rois, jnp.float32))
+
+
+def roi_perspective_transform(features, quads, out_size: Tuple[int, int],
+                              spatial_scale: float = 1.0):
+    """Perspective-warp quadrilateral RoIs to a fixed rectangle
+    (roi_perspective_transform_op.cc — used by OCR pipelines).
+
+    features [H, W, C]; quads [R, 8] = (x1,y1,...,x4,y4) clockwise from
+    top-left, in input coords. Computes the 3x3 homography mapping the
+    output rectangle onto each quad and bilinear-samples. -> [R, oh, ow, C].
+    """
+    hh, ww, _ = features.shape
+    oh, ow = out_size
+
+    def homography(quad):
+        # solve H (8 dof) s.t. H @ [u, v, 1] ~ quad corners, for the four
+        # output-rect corners (0,0), (ow-1,0), (ow-1,oh-1), (0,oh-1)
+        src = jnp.array([[0.0, 0.0], [ow - 1.0, 0.0],
+                         [ow - 1.0, oh - 1.0], [0.0, oh - 1.0]])
+        dst = quad.reshape(4, 2) * spatial_scale
+        rows = []
+        for i in range(4):
+            u, v = src[i, 0], src[i, 1]
+            x, y = dst[i, 0], dst[i, 1]
+            rows.append(jnp.array([u, v, 1.0, 0, 0, 0]).tolist()
+                        + [-u * x, -v * x])
+            rows.append(jnp.array([0, 0, 0.0, u, v, 1.0]).tolist()
+                        + [-u * y, -v * y])
+        amat = jnp.stack([jnp.stack([jnp.asarray(e, jnp.float32)
+                                     for e in row]) for row in rows])
+        bvec = dst.reshape(-1)
+        h8 = jnp.linalg.solve(amat, bvec)
+        return jnp.concatenate([h8, jnp.ones((1,))]).reshape(3, 3)
+
+    def one(quad):
+        hmat = homography(quad)
+        u = jnp.arange(ow, dtype=jnp.float32)
+        v = jnp.arange(oh, dtype=jnp.float32)
+        uu, vv = jnp.meshgrid(u, v)                   # [oh, ow]
+        ones = jnp.ones_like(uu)
+        pts = jnp.stack([uu, vv, ones], axis=-1) @ hmat.T   # [oh, ow, 3]
+        gx = pts[..., 0] / jnp.maximum(pts[..., 2], 1e-8)
+        gy = pts[..., 1] / jnp.maximum(pts[..., 2], 1e-8)
+        x0 = jnp.clip(jnp.floor(gx), 0, ww - 1)
+        y0 = jnp.clip(jnp.floor(gy), 0, hh - 1)
+        x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        wx = jnp.clip(gx - x0, 0, 1)[..., None]
+        wy = jnp.clip(gy - y0, 0, 1)[..., None]
+        f00 = features[y0i, x0i]
+        f01 = features[y0i, x1i]
+        f10 = features[y1i, x0i]
+        f11 = features[y1i, x1i]
+        val = ((f00 * (1 - wx) + f01 * wx) * (1 - wy)
+               + (f10 * (1 - wx) + f11 * wx) * wy)
+        inside = ((gx >= 0) & (gx <= ww - 1) & (gy >= 0)
+                  & (gy <= hh - 1))[..., None]
+        return jnp.where(inside, val, 0.0)
+
+    return jax.vmap(one)(jnp.asarray(quads, jnp.float32))
+
+
+# ---------------------------------------------------------------- YOLO loss
+
+def yolov3_loss(preds, gt_boxes, gt_labels, gt_valid, anchors,
+                num_classes: int, downsample: int = 32,
+                ignore_thresh: float = 0.7):
+    """YOLOv3 training loss (yolov3_loss_op.cc), single scale.
+
+    preds: [H, W, A*(5+num_classes)] raw head output (NHWC); anchors:
+    [A, 2] (w, h) in pixels; gt_boxes [G, 4] (cx, cy, w, h) normalized to
+    [0,1]; gt_labels [G] int; gt_valid [G] bool. Returns scalar loss:
+    bce(objectness) + bce(class) + l1(box) over responsible cells, with
+    non-responsible high-IoU predictions ignored, as in the reference.
+    """
+    h, w, _ = preds.shape
+    a = anchors.shape[0]
+    p = preds.reshape(h, w, a, 5 + num_classes)
+    tx, ty = p[..., 0], p[..., 1]
+    tw, th = p[..., 2], p[..., 3]
+    tobj = p[..., 4]
+    tcls = p[..., 5:]
+
+    img_w, img_h = w * downsample, h * downsample
+    anchors = jnp.asarray(anchors, jnp.float32)
+
+    # decode predictions to normalized boxes for the ignore-mask IoU test
+    gx = (jax.nn.sigmoid(tx) + jnp.arange(w)[None, :, None]) / w
+    gy = (jax.nn.sigmoid(ty) + jnp.arange(h)[:, None, None]) / h
+    gw = jnp.exp(jnp.clip(tw, -10, 10)) * anchors[None, None, :, 0] / img_w
+    gh = jnp.exp(jnp.clip(th, -10, 10)) * anchors[None, None, :, 1] / img_h
+    pred_boxes = jnp.stack([gx - gw / 2, gy - gh / 2,
+                            gx + gw / 2, gy + gh / 2], axis=-1)
+
+    gxyxy = jnp.stack([gt_boxes[:, 0] - gt_boxes[:, 2] / 2,
+                       gt_boxes[:, 1] - gt_boxes[:, 3] / 2,
+                       gt_boxes[:, 0] + gt_boxes[:, 2] / 2,
+                       gt_boxes[:, 1] + gt_boxes[:, 3] / 2], axis=-1)
+    iou_all = iou_similarity(gxyxy, pred_boxes.reshape(-1, 4))  # [G, HWA]
+    iou_all = jnp.where(gt_valid[:, None], iou_all, 0.0)
+    best_iou = jnp.max(iou_all, axis=0).reshape(h, w, a)
+    ignore = best_iou > ignore_thresh
+
+    # responsibility: per gt, the anchor with best shape-IoU at its cell
+    def per_gt(box, label, valid):
+        cx, cy, bw, bh = box
+        ci = jnp.clip((cx * w).astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip((cy * h).astype(jnp.int32), 0, h - 1)
+        # shape-only IoU vs anchors
+        aw, ah = anchors[:, 0] / img_w, anchors[:, 1] / img_h
+        inter = jnp.minimum(bw, aw) * jnp.minimum(bh, ah)
+        union = bw * bh + aw * ah - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9))
+        # targets
+        ttx = cx * w - ci
+        tty = cy * h - cj
+        ttw = jnp.log(jnp.maximum(bw * img_w, 1e-9)
+                      / anchors[best_a, 0])
+        tth = jnp.log(jnp.maximum(bh * img_h, 1e-9)
+                      / anchors[best_a, 1])
+        onehot = jax.nn.one_hot(label, num_classes)
+        scale = 2.0 - bw * bh      # small boxes weighted up (reference)
+        return cj, ci, best_a, jnp.array([ttx, tty, ttw, tth]), onehot, \
+            scale, valid
+
+    cj, ci, ba, tgt, onehot, scale, valid = jax.vmap(per_gt)(
+        gt_boxes, gt_labels, gt_valid)
+
+    obj_target = jnp.zeros((h, w, a))
+    obj_target = obj_target.at[cj, ci, ba].max(valid.astype(jnp.float32))
+    # ignore mask: no obj loss where a non-responsible pred overlaps a gt
+    noobj_w = jnp.where(ignore & (obj_target < 0.5), 0.0, 1.0)
+
+    bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    obj_loss = jnp.sum(bce(tobj, obj_target) * noobj_w)
+
+    def gt_losses(cj_i, ci_i, ba_i, tgt_i, oh_i, sc_i, valid_i):
+        px = jnp.array([jax.nn.sigmoid(tx[cj_i, ci_i, ba_i]),
+                        jax.nn.sigmoid(ty[cj_i, ci_i, ba_i]),
+                        tw[cj_i, ci_i, ba_i], th[cj_i, ci_i, ba_i]])
+        box_l = jnp.sum(jnp.abs(px - tgt_i)) * sc_i
+        cls_l = jnp.sum(bce(tcls[cj_i, ci_i, ba_i], oh_i))
+        return jnp.where(valid_i, box_l + cls_l, 0.0)
+
+    per_gt_loss = jax.vmap(gt_losses)(cj, ci, ba, tgt, onehot, scale, valid)
+    return obj_loss + jnp.sum(per_gt_loss)

@@ -116,6 +116,30 @@ def max_pool2d_with_index(x, kernel: int, stride: int):
     return out, idx.astype(jnp.int32)
 
 
+def max_pool3d_with_index(x, kernel: int, stride: int):
+    """3-D max pool returning flat argmax indices per window
+    (max_pool3d_with_index op, operators/pool_with_index_op.cc). x
+    [B, D, H, W, C] -> (out, idx) with idx = flat d*H*W + h*W + w."""
+    b, d, h, w, c = x.shape
+    pos = (jnp.arange(d)[:, None, None] * (h * w)
+           + jnp.arange(h)[None, :, None] * w
+           + jnp.arange(w)[None, None, :]).astype(jnp.float32)
+    pos = jnp.broadcast_to(pos[None, :, :, :, None], x.shape)
+    init = (-jnp.inf, 0.0)
+
+    def reducer(a, b_):
+        av, ai = a
+        bv, bi = b_
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = lax.reduce_window(
+        (x, pos), init, reducer,
+        window_dimensions=(1, kernel, kernel, kernel, 1),
+        window_strides=(1, stride, stride, stride, 1), padding="VALID")
+    return out, idx.astype(jnp.int32)
+
+
 def max_unpool2d(y, idx, out_hw: Tuple[int, int]):
     """Scatter pooled values back to their argmax positions (unpool op).
     y/idx [B, Hp, Wp, C] -> [B, H, W, C]."""
@@ -442,3 +466,30 @@ def positive_negative_pair(scores, labels, query_ids):
     neu = jnp.sum(pair & tie)
     neg = jnp.sum(pair) - pos - neu
     return pos, neg, neu
+
+
+def tree_conv(nodes, adjacency, weights, bias=None):
+    """Tree-based convolution (reference tree_conv op,
+    operators/tree_conv_op.cc — TBCNN continuous binary tree conv).
+
+    nodes: [N, F] node features; adjacency: [N, N] bool, adjacency[p, c]
+    True when c is a child of p; weights: [F, 3, O] — the (top, left,
+    right) basis matrices. Each node's receptive patch is itself (top
+    basis) plus its children mixed between the left/right bases by their
+    normalized sibling position. Returns [N, O].
+    """
+    n = nodes.shape[0]
+    adj = adjacency.astype(jnp.float32)                      # [N, N]
+    nc = jnp.sum(adj, axis=1, keepdims=True)                 # children/node
+    # sibling position r in [0, 1]: rank of child among its siblings
+    order = jnp.cumsum(adj, axis=1) * adj                    # 1-based ranks
+    denom = jnp.maximum(nc - 1.0, 1.0)
+    r = (order - 1.0) / denom * adj                          # [N, N]
+    eta_l = (1.0 - r) * adj
+    eta_r = r * adj
+    w_t, w_l, w_r = weights[:, 0], weights[:, 1], weights[:, 2]  # [F, O]
+    out = nodes @ w_t                                        # self/top term
+    out = out + (eta_l @ nodes) @ w_l + (eta_r @ nodes) @ w_r
+    if bias is not None:
+        out = out + bias
+    return out

@@ -157,6 +157,39 @@ def sequence_expand_padded(x, ref_lengths, maxlen: int):
     return x[:, None, :] * mask[..., None]
 
 
+def sequence_expand_as(x, ref_lengths, maxlen: int):
+    """Reference sequence_expand_as op (sequence_expand_as_op.cc): repeat
+    row i of x ref_lengths[i] times. Padded form: [B, D] -> [B, maxlen, D]
+    with positions beyond ref_lengths[i] zeroed (same contract as
+    sequence_expand_padded, kept as a named alias for API parity)."""
+    return sequence_expand_padded(x, ref_lengths, maxlen)
+
+
+def sequence_reshape(x, lengths, new_dim: int):
+    """Reference sequence_reshape op (sequence_reshape_op.cc): reinterpret
+    each sequence's [len_i, D] payload as [len_i*D/new_dim, new_dim].
+    Padded form: [B, T, D] -> [B, T*D//new_dim, new_dim] + new lengths.
+    Requires (T*D) % new_dim == 0 for the padded buffer."""
+    b, t, d = x.shape
+    assert (t * d) % new_dim == 0, "padded payload must divide new_dim"
+    new_t = t * d // new_dim
+    out = x.reshape(b, new_t, new_dim)
+    new_lengths = (lengths * d) // new_dim
+    mask = sequence_mask(new_lengths, new_t, x.dtype)
+    return out * mask[..., None], new_lengths
+
+
+def sequence_scatter(x, index, updates, updates_lengths):
+    """Reference sequence_scatter op (sequence_scatter_op.cc): per sample i,
+    x[i, index[i, j]] += updates[i, j] for j < updates_lengths[i].
+    x: [B, N]; index/updates: [B, T]."""
+    b, t = index.shape
+    mask = sequence_mask(updates_lengths, t, updates.dtype)
+    upd = updates * mask
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    return x.at[bidx, index.astype(jnp.int32)].add(upd)
+
+
 def sequence_reverse(x, lengths):
     """Reverse valid prefix of each row [B, T, ...]
     (sequence_reverse_op.cc)."""

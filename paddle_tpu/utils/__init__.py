@@ -1,2 +1,3 @@
 from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils import log
+from paddle_tpu.utils.debug import dump_hlo, memory_stats, module_tree

@@ -1,0 +1,99 @@
+"""Observability utilities: device memory stats, HLO dumps, module trees.
+
+Capability-equivalent of the reference's introspection surface:
+- memory_stats ≈ paddle.fluid.core get_mem_usage
+  (/root/reference/paddle/fluid/pybind/pybind.cc:131) and
+  contrib/memory_usage_calc.py;
+- dump_hlo ≈ Program.to_string / debugger.draw_block_graphviz
+  (/root/reference/python/paddle/fluid/framework.py:406,
+  debugger.py) — here the "program" is the XLA computation, so the dump
+  tiers are jaxpr, StableHLO, and post-optimization HLO;
+- module_tree ≈ the Program/Block pretty printer + net_drawer.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from paddle_tpu.core.module import Module
+
+
+def memory_stats(device=None) -> Dict[str, Any]:
+    """Per-device live-buffer statistics.
+
+    Returns {device, bytes_in_use, peak_bytes_in_use, num_allocs, ...} from
+    the runtime allocator when the backend exposes them (TPU does), falling
+    back to a live-buffer walk on CPU. ≈ reference get_mem_usage
+    (pybind.cc:131) / memory_usage_calc.py.
+    """
+    devs = [device] if device is not None else jax.local_devices()
+    out = {}
+    for d in devs:
+        stats: Dict[str, Any]
+        try:
+            stats = dict(d.memory_stats() or {})
+        except Exception:
+            stats = {}
+        if not stats:
+            live = [b for b in jax.live_arrays() if d in b.devices()]
+            stats = {
+                "bytes_in_use": sum(int(b.nbytes) for b in live),
+                "num_live_buffers": len(live),
+                "source": "live_arrays_walk",
+            }
+        out[str(d)] = stats
+    return out if device is None else out[str(devs[0])]
+
+
+def dump_hlo(fn: Callable, *args, stage: str = "stablehlo",
+             static_argnums=(), **kwargs) -> str:
+    """Text dump of the compiled form of `fn(*args)`.
+
+    stage: "jaxpr" (traced jaxpr), "stablehlo" (lowered portable IR), or
+    "optimized" (backend-optimized HLO — what actually runs, post-fusion;
+    the analog of inspecting the reference's fused graph after its pass
+    pipeline, ir/graph_viz_pass.cc).
+    """
+    if stage == "jaxpr":
+        return str(jax.make_jaxpr(fn, static_argnums=static_argnums)(
+            *args, **kwargs))
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(
+        *args, **kwargs)
+    if stage == "stablehlo":
+        return lowered.as_text()
+    if stage == "optimized":
+        return lowered.compile().as_text()
+    raise ValueError(f"unknown stage {stage!r}; "
+                     "use jaxpr | stablehlo | optimized")
+
+
+def module_tree(module: Module, variables: Optional[Dict] = None,
+                _name: str = "", _indent: int = 0) -> str:
+    """Pretty-print a module hierarchy with parameter shapes/counts.
+
+    ≈ the reference Program printer (framework.py:406 to_string) and
+    debugger.py's block dump, at module granularity.
+    """
+    lines: List[str] = []
+    params = (variables or {}).get("params", variables) or {}
+
+    def walk(m: Module, name: str, p: Any, indent: int):
+        own = {k: v for k, v in (p or {}).items()
+               if not isinstance(v, dict)} if isinstance(p, dict) else {}
+        n_params = sum(getattr(v, "size", 0) for v in jax.tree.leaves(
+            p if isinstance(p, dict) else {}))
+        head = "  " * indent + (name or type(m).__name__)
+        desc = type(m).__name__
+        extra = f" params={n_params:,}" if n_params else ""
+        lines.append(f"{head}: {desc}{extra}")
+        for k, v in own.items():
+            shape = tuple(getattr(v, "shape", ()))
+            lines.append("  " * (indent + 1) + f".{k} {shape}")
+        for cname, child in m.children().items():
+            cp = p.get(cname) if isinstance(p, dict) else None
+            walk(child, cname, cp, indent + 1)
+
+    walk(module, _name, params, _indent)
+    return "\n".join(lines)

@@ -1,0 +1,44 @@
+"""Observability utils (reference pybind.cc:131 get_mem_usage,
+framework.py:406 to_string, debugger.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.utils.debug import dump_hlo, memory_stats, module_tree
+
+
+def test_memory_stats_reports_bytes():
+    x = jnp.ones((128, 128))
+    x.block_until_ready()
+    stats = memory_stats()
+    assert isinstance(stats, dict) and stats
+    one = next(iter(stats.values()))
+    assert "bytes_in_use" in one
+    assert one["bytes_in_use"] > 0
+
+
+def test_dump_hlo_stages():
+    def f(a, b):
+        return jnp.tanh(a @ b)
+
+    a = jnp.ones((4, 8))
+    b = jnp.ones((8, 2))
+    jx = dump_hlo(f, a, b, stage="jaxpr")
+    assert "tanh" in jx
+    sh = dump_hlo(f, a, b, stage="stablehlo")
+    assert "stablehlo" in sh or "mhlo" in sh or "func" in sh
+    opt = dump_hlo(f, a, b, stage="optimized")
+    assert "HloModule" in opt or "ENTRY" in opt
+
+
+def test_module_tree_printer():
+    from paddle_tpu.models import LeNet
+    m = LeNet(num_classes=10)
+    variables = m.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    txt = module_tree(m, variables)
+    assert "LeNet" in txt
+    assert "conv1" in txt and "fc2" in txt
+    assert "params=" in txt
+    # weight shapes shown
+    assert "(5, 5, 1, 20)" in txt

@@ -1,0 +1,135 @@
+"""Tests for the round-3 detection op tail (rpn_target_assign,
+generate_proposal_labels, generate_mask_labels, psroi_pool,
+roi_perspective_transform, yolov3_loss) and the DetectionMAP /
+PrecisionRecall metrics (reference: operators/detection/,
+operators/metrics/precision_recall_op.cc, metrics.py:566)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.metrics import DetectionMAP, PrecisionRecall
+from paddle_tpu.ops import detection as D
+
+
+def test_rpn_target_assign_basic():
+    anchors = jnp.asarray([[0, 0, 10, 10], [20, 20, 30, 30],
+                           [0, 0, 9, 9], [100, 100, 110, 110]],
+                          jnp.float32)
+    gt = jnp.asarray([[0, 0, 10, 10]], jnp.float32)
+    labels, targets, fg_w = D.rpn_target_assign(
+        anchors, gt, jnp.array([True]), jax.random.key(0),
+        num_samples=4, positive_overlap=0.7, negative_overlap=0.3)
+    labels = np.asarray(labels)
+    assert labels[0] == 1                 # exact match anchor is fg
+    assert labels[3] in (0, -1)           # distant anchor is bg (or unsampled)
+    # fg target deltas for the exact-match anchor are ~0
+    np.testing.assert_allclose(np.asarray(targets)[0], 0.0, atol=1e-5)
+    assert np.asarray(fg_w)[0] == 1.0
+
+
+def test_generate_proposal_labels_shapes_and_fg():
+    rs = np.random.RandomState(0)
+    rois = jnp.asarray(np.abs(rs.randn(32, 4)) * 20, jnp.float32)
+    rois = rois.at[:, 2:].set(rois[:, :2] + 10)
+    # make roi 0 coincide with gt 0
+    gt = jnp.asarray([[0, 0, 10, 10], [50, 50, 60, 60]], jnp.float32)
+    rois = rois.at[0].set(gt[0])
+    out_rois, labels, targets, fg = D.generate_proposal_labels(
+        rois, gt, jnp.asarray([3, 7]), jnp.array([True, True]),
+        jax.random.key(1), batch_size_per_im=16)
+    assert out_rois.shape == (16, 4)
+    assert labels.shape == (16,)
+    labels = np.asarray(labels)
+    fg = np.asarray(fg)
+    # the coincident roi must be sampled fg with its gt class
+    assert 3 in labels[fg > 0]
+
+
+def test_generate_mask_labels_crop():
+    gt_masks = jnp.zeros((1, 20, 20)).at[:, 5:15, 5:15].set(1.0)
+    rois = jnp.asarray([[5, 5, 15, 15]], jnp.float32)
+    out = D.generate_mask_labels(rois, jnp.array([1.0]),
+                                 jnp.array([0]), gt_masks, resolution=8)
+    assert out.shape == (1, 8, 8)
+    assert float(out.mean()) > 0.9        # roi covers the solid square
+
+
+def test_psroi_pool_channel_groups():
+    ph = pw = 2
+    out_c = 3
+    rs = np.random.RandomState(1)
+    feats = jnp.asarray(rs.randn(8, 8, ph * pw * out_c), jnp.float32)
+    rois = jnp.asarray([[0, 0, 8, 8]], jnp.float32)
+    out = D.psroi_pool(feats, rois, (ph, pw))
+    assert out.shape == (1, ph, pw, out_c)
+    # bin (0,0) uses channel group 0: check it differs from naive group
+    full = D.roi_align(feats, rois, (ph, pw))
+    np.testing.assert_allclose(np.asarray(out[0, 0, 0]),
+                               np.asarray(full[0, 0, 0, 0:out_c]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_roi_perspective_transform_identity():
+    rs = np.random.RandomState(2)
+    feats = jnp.asarray(rs.randn(8, 8, 2), jnp.float32)
+    # quad == the whole feature map, axis-aligned -> output ≈ resize
+    quads = jnp.asarray([[0, 0, 7, 0, 7, 7, 0, 7]], jnp.float32)
+    out = D.roi_perspective_transform(feats, quads, (8, 8))
+    assert out.shape == (1, 8, 8, 2)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(feats),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_yolov3_loss_decreases_on_fit():
+    rs = np.random.RandomState(3)
+    h = w = 4
+    a = 2
+    nc = 3
+    anchors = jnp.asarray([[32, 32], [64, 64]], jnp.float32)
+    gt = jnp.asarray([[0.5, 0.5, 0.25, 0.25]], jnp.float32)
+    lbl = jnp.asarray([1])
+    valid = jnp.asarray([True])
+    preds = jnp.asarray(rs.randn(h, w, a * (5 + nc)) * 0.1, jnp.float32)
+
+    def loss(p):
+        return D.yolov3_loss(p, gt, lbl, valid, anchors, nc, downsample=32)
+
+    l0 = float(loss(preds))
+    g = jax.grad(loss)(preds)
+    assert np.isfinite(l0)
+    assert float(jnp.sum(jnp.abs(g))) > 0
+    p2 = preds - 0.1 * g
+    assert float(loss(p2)) < l0
+
+
+def test_detection_map_perfect_and_miss():
+    m = DetectionMAP(overlap_threshold=0.5)
+    # perfect detection
+    m.update([[0, 0.9, 0, 0, 10, 10]], [[0, 0, 0, 10, 10]])
+    assert m.eval() == pytest.approx(1.0)
+    m.reset()
+    # complete miss
+    m.update([[0, 0.9, 50, 50, 60, 60]], [[0, 0, 0, 10, 10]])
+    assert m.eval() == pytest.approx(0.0)
+    m.reset()
+    # one tp at high score, one fp at low score -> AP stays 1.0 (integral)
+    m.update([[0, 0.9, 0, 0, 10, 10], [0, 0.1, 50, 50, 60, 60]],
+             [[0, 0, 0, 10, 10]])
+    assert m.eval() == pytest.approx(1.0)
+
+
+def test_detection_map_11point():
+    m = DetectionMAP(ap_version="11point")
+    m.update([[0, 0.9, 0, 0, 10, 10]], [[0, 0, 0, 10, 10]])
+    assert m.eval() == pytest.approx(1.0)
+
+
+def test_precision_recall_multiclass():
+    m = PrecisionRecall(num_classes=3)
+    m.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 2]))
+    out = m.eval()
+    assert out["micro_precision"] == pytest.approx(3 / 4)
+    assert out["micro_recall"] == pytest.approx(3 / 4)
+    assert 0 < out["macro_f1"] <= 1.0

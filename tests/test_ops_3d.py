@@ -1,0 +1,106 @@
+"""Tests for the 3-D conv/pool family, lrn, DataNorm, and op-tail additions
+(reference: conv_op.cc conv3d, pool_op.cc pool3d, lrn_op.cc,
+data_norm_op.cc, pool_with_index_op.cc)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.nn import (Conv3D, Conv3DTranspose, DataNorm, avg_pool3d,
+                           lrn, max_pool3d)
+from paddle_tpu.core.module import Module
+from paddle_tpu.ops.extras import max_pool3d_with_index
+from paddle_tpu.testing.op_test import check_grad
+
+
+def test_conv3d_shape_and_grad():
+    m = Conv3D(4, 3, stride=1, padding="SAME")
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 6, 7, 3),
+                    jnp.float32)
+    variables = m.init(jax.random.key(0), x)
+    y = m.apply(variables, x)
+    assert y.shape == (2, 5, 6, 7, 4)
+
+    # grads flow to kernel
+    def loss(params):
+        return jnp.sum(m.apply({"params": params}, x) ** 2)
+    g = jax.grad(loss)(variables["params"])
+    assert g["weight"].shape == (3, 3, 3, 3, 4)
+    assert float(jnp.sum(jnp.abs(g["weight"]))) > 0
+
+
+def test_conv3d_matches_manual_valid():
+    # 1x1x1 kernel VALID conv == pointwise matmul
+    m = Conv3D(2, 1, padding="VALID", use_bias=False)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 3, 3, 3, 5),
+                    jnp.float32)
+    variables = m.init(jax.random.key(0), x)
+    y = m.apply(variables, x)
+    w = variables["params"]["weight"][0, 0, 0]     # [5, 2]
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(x @ w), rtol=1e-5, atol=1e-5)
+
+
+def test_conv3d_transpose_shape():
+    m = Conv3DTranspose(3, 2, stride=2)
+    x = jnp.zeros((1, 4, 4, 4, 6))
+    variables = m.init(jax.random.key(0), x)
+    y = m.apply(variables, x)
+    assert y.shape == (1, 8, 8, 8, 3)
+
+
+def test_pool3d_matches_numpy():
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 4, 4, 4, 2).astype(np.float32)
+    got_max = np.asarray(max_pool3d(jnp.asarray(x), 2, 2))
+    got_avg = np.asarray(avg_pool3d(jnp.asarray(x), 2, 2))
+    blocks = x.reshape(1, 2, 2, 2, 2, 2, 2, 2)      # B,d,2,h,2,w,2,C
+    want_max = blocks.max(axis=(2, 4, 6))
+    want_avg = blocks.mean(axis=(2, 4, 6))
+    np.testing.assert_allclose(got_max, want_max, rtol=1e-6)
+    np.testing.assert_allclose(got_avg, want_avg, rtol=1e-6)
+
+
+def test_max_pool3d_with_index_roundtrip():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 4, 4, 4, 3).astype(np.float32)
+    out, idx = max_pool3d_with_index(jnp.asarray(x), 2, 2)
+    assert out.shape == (2, 2, 2, 2, 3)
+    assert idx.shape == out.shape
+    # index points at the max value
+    flat = x.reshape(2, 64, 3)
+    picked = np.take_along_axis(flat, np.asarray(idx).reshape(2, 8, 3),
+                                axis=1).reshape(out.shape)
+    np.testing.assert_allclose(np.asarray(out), picked, rtol=1e-6)
+
+
+def test_lrn_reference_formula():
+    rs = np.random.RandomState(4)
+    x = rs.randn(1, 2, 2, 6).astype(np.float32)
+    n, k, alpha, beta = 5, 1.0, 1e-4, 0.75
+    got = np.asarray(lrn(jnp.asarray(x), n, k, alpha, beta))
+    want = np.empty_like(x)
+    for c in range(6):
+        lo, hi = max(0, c - n // 2), min(6, c - n // 2 + n)
+        denom = k + alpha * np.sum(x[..., lo:hi] ** 2, axis=-1)
+        want[..., c] = x[..., c] / denom ** beta
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_data_norm_streaming_stats():
+    m = DataNorm()
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(32, 4) * 3.0 + 1.0, jnp.float32)
+    variables = m.init(jax.random.key(0), x)
+    y, mut = m.apply(variables, x, training=True, mutable=True)
+    st = mut["state"]
+    assert float(st["count"]) == pytest.approx(33.0)   # init 1 + 32
+    # after many updates the running stats approach the true moments
+    for _ in range(20):
+        y, mut = m.apply({"params": {}, "state": st}, x, training=True,
+                         mutable=True)
+        st = mut["state"]
+    normed = np.asarray(y)
+    assert abs(normed.mean()) < 0.2
+    assert abs(normed.std() - 1.0) < 0.2

@@ -78,3 +78,21 @@ def test_stacked_lstm_grad_flows(rng):
     norms = [float(jnp.linalg.norm(l)) for l in jax.tree.leaves(g)]
     assert all(np.isfinite(n) for n in norms)
     assert any(n > 0 for n in norms)
+
+
+def test_lstmp_projection():
+    """LSTM with recurrent projection (reference lstmp op)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn.rnn import LSTMCell
+
+    cell = LSTMCell(16, proj_size=8)
+    carry = cell.init_carry(4)
+    assert carry[0].shape == (4, 8)       # projected h
+    assert carry[1].shape == (4, 16)      # full c
+    x = jnp.ones((4, 5))
+    variables = cell.init(jax.random.key(0), carry, x)
+    (h2, c2), out = cell.apply(variables, carry, x)
+    assert h2.shape == (4, 8)
+    assert c2.shape == (4, 16)
+    assert out.shape == (4, 8)

@@ -1,0 +1,39 @@
+"""Round-3 sequence op tail: sequence_expand_as, sequence_reshape,
+sequence_scatter (reference operators/sequence_ops/)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.sequence import (sequence_expand_as, sequence_reshape,
+                                     sequence_scatter)
+
+
+def test_sequence_expand_as():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    out = sequence_expand_as(x, jnp.asarray([2, 1]), maxlen=3)
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), [1, 2])
+    np.testing.assert_allclose(np.asarray(out[0, 1]), [1, 2])
+    np.testing.assert_allclose(np.asarray(out[0, 2]), [0, 0])
+    np.testing.assert_allclose(np.asarray(out[1, 1]), [0, 0])
+
+
+def test_sequence_reshape_roundtrip():
+    x = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+    lengths = jnp.asarray([2, 3])
+    out, new_len = sequence_reshape(x, lengths, new_dim=2)
+    assert out.shape == (2, 6, 2)
+    np.testing.assert_array_equal(np.asarray(new_len), [4, 6])
+    # payload of row 0 (2 steps * 4 dims = 8 values -> 4 steps of 2)
+    np.testing.assert_allclose(np.asarray(out[0, :4]).reshape(-1),
+                               np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(np.asarray(out[0, 4:]), 0.0)
+
+
+def test_sequence_scatter_masks_padding():
+    x = jnp.zeros((2, 5))
+    idx = jnp.asarray([[0, 1, 1], [4, 0, 0]])
+    upd = jnp.asarray([[1.0, 2.0, 3.0], [7.0, 9.0, 9.0]])
+    out = sequence_scatter(x, idx, upd, jnp.asarray([3, 1]))
+    np.testing.assert_allclose(np.asarray(out[0]), [1, 5, 0, 0, 0])
+    np.testing.assert_allclose(np.asarray(out[1]), [0, 0, 0, 0, 7])
