@@ -40,9 +40,33 @@ def main(argv=None) -> int:
     p.add_argument("--gradient_accumulation", type=int, default=1)
     p.add_argument("--json", action="store_true",
                    help="one JSON object per line instead of a table")
+    p.add_argument("--scaling", default=None, metavar="SIZES",
+                   help="weak-scaling sweep over dp mesh sizes, e.g. "
+                        "'1,2,4,8': per-chip throughput + efficiency "
+                        "(per-chip batch from --batch_size, default 32)")
     args = p.parse_args(argv)
 
     from paddle_tpu.benchmark.models import MODELS, run_model
+
+    if args.scaling:
+        from paddle_tpu.benchmark.scaling import run_scaling
+        sizes = [int(s) for s in args.scaling.split(",")]
+        dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+        rows = run_scaling(args.model if args.model != "all" else "mlp",
+                           sizes=sizes,
+                           per_chip_batch=args.batch_size or 32,
+                           dtype=dtype, min_time=args.min_time)
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif "skipped" in row:
+                print(f"dp={row['dp']:<3} skipped ({row['skipped']})")
+            else:
+                print(f"dp={row['dp']:<3} {row['value']:12.1f} "
+                      f"{row['unit']:<9} per-chip {row['per_chip']:10.1f}  "
+                      f"eff {row['efficiency'] * 100:6.1f}%  "
+                      f"[{row['platform']}]")
+        return 0
 
     mesh = strategy = rules = None
     if args.dp or args.fsdp or args.tp:

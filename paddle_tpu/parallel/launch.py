@@ -33,11 +33,22 @@ def launch(nproc: int, command: Sequence[str],
            coordinator: Optional[str] = None,
            cpu_devices_per_proc: Optional[int] = None,
            env: Optional[dict] = None,
-           timeout: float = 600.0) -> List[subprocess.CompletedProcess]:
+           timeout: float = 600.0,
+           peer_failure_grace: float = 5.0
+           ) -> List[subprocess.CompletedProcess]:
     """Spawn `nproc` copies of `command` wired into one jax.distributed
     world. Returns per-process CompletedProcess (stdout/stderr captured).
-    Raises RuntimeError if any process fails — with every log tail, since
-    a dead peer usually makes the others die of barrier timeouts."""
+
+    Failure detection (the reference has none — SURVEY §5.3 "no elastic
+    re-scheduling"; this harness exceeds it): a watchdog polls the
+    children, and when one dies with a nonzero rc while peers are still
+    running, the peers get `peer_failure_grace` seconds to notice (barrier
+    error) and are then terminated — survivors fail FAST with a clear
+    "peer died" report instead of hanging in a collective until `timeout`.
+    RuntimeError carries every process's rc and log tail.
+    """
+    import time as _time
+
     coordinator = coordinator or f"127.0.0.1:{free_port()}"
     procs = []
     for i in range(nproc):
@@ -59,26 +70,68 @@ def launch(nproc: int, command: Sequence[str],
             list(command), env=penv, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
 
-    # Drain every process concurrently: sequential communicate() deadlocks
-    # when a later process fills its ~64KB pipe buffer and blocks while the
-    # first one waits for it at a collective.
-    import concurrent.futures as cf
+    # Drain threads start IMMEDIATELY (communicate() in a thread per
+    # child): a child that logs more than the ~64KB pipe buffer must
+    # never block on write while the watchdog below polls exit codes.
+    import threading
 
-    def drain(p):
-        try:
-            out, err = p.communicate(timeout=timeout)
-            return subprocess.CompletedProcess(p.args, p.returncode,
-                                               out, err)
-        except subprocess.TimeoutExpired:
+    outputs: List[Optional[tuple]] = [None] * nproc
+
+    def drain(i, p):
+        outputs[i] = p.communicate()     # returns at process EOF/exit
+
+    threads = [threading.Thread(target=drain, args=(i, p), daemon=True)
+               for i, p in enumerate(procs)]
+    for t in threads:
+        t.start()
+
+    # Watchdog loop: detect a dead child early and reap the survivors.
+    deadline = _time.monotonic() + timeout
+    first_fault: Optional[int] = None
+    fault_time = 0.0
+    killed_as_survivor: List[int] = []
+    while True:
+        codes = [p.poll() for p in procs]
+        if all(c is not None for c in codes):
+            break
+        now = _time.monotonic()
+        if first_fault is None:
+            for i, c in enumerate(codes):
+                if c is not None and c != 0:
+                    first_fault, fault_time = i, now
+                    break
+        if first_fault is not None and now - fault_time > peer_failure_grace:
+            for i, p in enumerate(procs):
+                if p.poll() is None:
+                    killed_as_survivor.append(i)
+                    p.terminate()
+            break
+        if now > deadline:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            break
+        _time.sleep(0.2)
+
+    results = []
+    for i, (p, t) in enumerate(zip(procs, threads)):
+        t.join(timeout=30)
+        if t.is_alive():                 # terminate didn't stick
             p.kill()
-            out, err = p.communicate()
-            return subprocess.CompletedProcess(p.args, -9, out, err)
-
-    with cf.ThreadPoolExecutor(max_workers=nproc) as pool:
-        results = list(pool.map(drain, procs))
+            t.join(timeout=10)
+        out, err = outputs[i] or ("", "")
+        results.append(subprocess.CompletedProcess(
+            p.args, p.returncode if p.returncode is not None else -9,
+            out, err))
     failed = any(r.returncode != 0 for r in results)
     if failed:
         msgs = []
+        if first_fault is not None:
+            msgs.append(
+                f"peer failure: proc {first_fault} died "
+                f"(rc={results[first_fault].returncode}); survivors "
+                f"{killed_as_survivor} terminated after "
+                f"{peer_failure_grace}s grace")
         for i, r in enumerate(results):
             msgs.append(f"--- proc {i} rc={r.returncode}\n"
                         f"stdout:\n{r.stdout[-2000:]}\n"

@@ -1,0 +1,95 @@
+"""Worker for the fault-injection + elastic-restart test.
+
+Reference pattern: test_dist_base.py:341 subprocess clusters — extended
+per SURVEY §5.3 with the fault-injection knob the reference lacks:
+PTPU_FAULT_PROC/PTPU_FAULT_STEP make that process die (os._exit) at the
+start of that step, mid-run. Recovery is checkpoint/resume: every step is
+checkpointed via CheckpointManager; on start the worker restores the
+latest checkpoint and continues. Batches are keyed by global step, so an
+interrupted + restarted run reproduces the uninterrupted loss curve
+exactly.
+
+Prints ONE json line: {"proc", "start_step", "steps": [...], "losses":
+[...]}.
+"""
+
+import json
+import os
+import sys
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.metrics import accuracy
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import MeshConfig, MeshTrainer, make_mesh
+    from paddle_tpu.parallel.distributed import (
+        init_distributed, process_index)
+
+    init_distributed()
+    proc = process_index()
+    ndev = jax.device_count()
+
+    ckpt_dir = os.environ["PTPU_CKPT_DIR"]
+    total_steps = int(os.environ.get("PTPU_TOTAL_STEPS", "6"))
+    fault_proc = int(os.environ.get("PTPU_FAULT_PROC", "-1"))
+    fault_step = int(os.environ.get("PTPU_FAULT_STEP", "-1"))
+
+    mesh = make_mesh(MeshConfig(dp=ndev))
+    model = MLP(hidden=(16,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y),
+        metrics={"acc": accuracy})
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh)
+
+    gbs = 4 * ndev
+    ts = trainer.init_state(jnp.zeros((gbs, 6)))
+    mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+    restored, start_step = mgr.restore_latest(ts)
+    if restored is not None:
+        ts = restored
+    else:
+        start_step = 0
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    bsh = NamedSharding(mesh, P("dp"))
+
+    def batch_for(step):
+        rs = np.random.RandomState(1000 + step)     # keyed by global step
+        gx = rs.randn(gbs, 6).astype(np.float32)
+        gy = rs.randint(0, 4, gbs).astype(np.int64)
+        per = gbs // int(os.environ["PTPU_NUM_PROCESSES"])
+        lo = proc * per
+        x = jax.make_array_from_process_local_data(bsh, gx[lo:lo + per])
+        y = jax.make_array_from_process_local_data(bsh, gy[lo:lo + per])
+        return x, y
+
+    steps, losses = [], []
+    for step in range(start_step, total_steps):
+        if proc == fault_proc and step == fault_step:
+            # simulated hard crash: no cleanup, no checkpoint, no goodbye
+            os._exit(17)
+        ts, fetches = trainer.train_step(ts, batch_for(step),
+                                         rng=jax.random.key(step))
+        steps.append(step)
+        losses.append(float(fetches["loss"]))
+        mgr.save(ts, step=step + 1)
+
+    print(json.dumps({"proc": proc, "start_step": start_step,
+                      "steps": steps, "losses": losses}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

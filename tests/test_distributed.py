@@ -73,3 +73,74 @@ def test_launcher_reports_failures():
 def test_free_port():
     p1, p2 = free_port(), free_port()
     assert 1024 <= p1 <= 65535 and 1024 <= p2 <= 65535
+
+
+ELASTIC = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+DEEPFM = os.path.join(os.path.dirname(__file__), "dist_worker_deepfm.py")
+
+
+def _env(extra=None):
+    env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.update(extra or {})
+    return env
+
+
+def test_fault_injection_and_elastic_restart(tmp_path):
+    """SURVEY §5.3 / VERDICT r3 #4: kill one proc mid-run; survivors fail
+    fast with a clear peer-death report; a restart resumes from the last
+    committed checkpoint and reproduces the uninterrupted loss curve."""
+    ckpt = str(tmp_path / "elastic")
+    total = {"PTPU_CKPT_DIR": ckpt, "PTPU_TOTAL_STEPS": "6"}
+
+    # run 1: proc 1 hard-crashes at step 3 (steps 0-2 checkpointed)
+    with pytest.raises(RuntimeError) as e:
+        launch(2, [sys.executable, ELASTIC], cpu_devices_per_proc=2,
+               env=_env({**total, "PTPU_FAULT_PROC": "1",
+                         "PTPU_FAULT_STEP": "3"}),
+               timeout=240, peer_failure_grace=3.0)
+    msg = str(e.value)
+    assert "peer failure: proc 1 died (rc=17)" in msg
+    assert "survivors [0] terminated" in msg
+
+    # restart: same command, no fault -> resumes from ckpt and finishes
+    results = launch(2, [sys.executable, ELASTIC], cpu_devices_per_proc=2,
+                     env=_env(total), timeout=240)
+    outs = [json.loads([l for l in r.stdout.splitlines()
+                        if l.startswith("{")][-1]) for r in results]
+    assert all(o["start_step"] == 3 for o in outs)   # resumed, not restarted
+    assert outs[0]["steps"] == [3, 4, 5]
+
+    # the stitched loss curve equals an uninterrupted run
+    clean = str(tmp_path / "clean")
+    results2 = launch(2, [sys.executable, ELASTIC], cpu_devices_per_proc=2,
+                      env=_env({"PTPU_CKPT_DIR": clean,
+                                "PTPU_TOTAL_STEPS": "6"}), timeout=240)
+    solo = json.loads([l for l in results2[0].stdout.splitlines()
+                       if l.startswith("{")][-1])
+    np.testing.assert_allclose(outs[0]["losses"], solo["losses"][3:],
+                               atol=1e-5)
+
+
+def test_two_process_sharded_embedding_deepfm():
+    """VERDICT r3 #8: DeepFM + ShardedEmbedding through the launcher
+    (2 procs x 2 devices) matches the single-process run, with the table
+    row-sharded across process boundaries (pserver capability e2e)."""
+    outs = []
+    for r in launch(2, [sys.executable, DEEPFM], cpu_devices_per_proc=2,
+                    env=_env(), timeout=300):
+        outs.append(json.loads([l for l in r.stdout.splitlines()
+                                if l.startswith("{")][-1]))
+    assert {o["proc"] for o in outs} == {0, 1}
+    for o in outs:
+        assert o["ndev"] == 4
+        # each device owns a strict slice of the table (vocab/fsdp rows)
+        assert o["local_rows"] == o["total_rows"] // 2
+    np.testing.assert_allclose(outs[0]["losses"], outs[1]["losses"],
+                               rtol=1e-6)
+    assert outs[0]["losses"][-1] < outs[0]["losses"][0]
+
+    single = launch(1, [sys.executable, DEEPFM], cpu_devices_per_proc=4,
+                    env=_env(), timeout=300)
+    solo = json.loads([l for l in single[0].stdout.splitlines()
+                       if l.startswith("{")][-1])
+    np.testing.assert_allclose(outs[0]["losses"], solo["losses"], atol=1e-5)

@@ -1,0 +1,24 @@
+"""flash_selfcheck: the bench-side on-hardware correctness gate.
+On CPU the dispatch gate is forced (interpret-mode kernels) so the check
+logic itself is validated without TPU hardware."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import attention as A
+from paddle_tpu.kernels.selfcheck import flash_selfcheck
+
+
+def test_flash_selfcheck_on_cpu(monkeypatch):
+    import jax.numpy as jnp
+    monkeypatch.setattr(A, "_on_tpu", lambda: True)  # force dispatch gate
+    out = flash_selfcheck(batch=1, heads=2, seq=512, head_dim=32,
+                          dtype=jnp.float32, atol=1e-3)
+    assert out["flash_check"] == "ok"
+    assert out["flash_max_rel_err"] < 1e-3
+
+
+def test_flash_selfcheck_detects_gate_not_taken(monkeypatch):
+    monkeypatch.setattr(A, "_on_tpu", lambda: False)
+    with pytest.raises(AssertionError, match="did NOT take the flash path"):
+        flash_selfcheck(batch=1, heads=2, seq=512, head_dim=32)
