@@ -75,6 +75,14 @@ def build_demo() -> Optional[str]:
                   shared=False, extra=[lib, f"-Wl,-rpath,{_cache_dir()}"])
 
 
+def build_train_demo() -> Optional[str]:
+    """Compile the standalone C++ *training* demo (reference
+    train/demo/demo_trainer.cc capability: a native app owning the train
+    loop, feeding C buffers zero-copy and checkpointing at the end)."""
+    return _build(os.path.join(_SRC_DIR, "train_demo.cc"),
+                  "ptpu_train_demo", shared=False)
+
+
 class _Tensor(ctypes.Structure):
     _fields_ = [("dtype", ctypes.c_int), ("rank", ctypes.c_int),
                 ("shape", ctypes.POINTER(ctypes.c_int64)),

@@ -1,0 +1,256 @@
+"""Book-model integration suite: the reference's 8 end-to-end chapters
+(/root/reference/python/paddle/fluid/tests/book/) re-built on paddle_tpu —
+each trains to a decreasing/threshold loss on its dataset reader and the
+first also round-trips the inference-export path, mirroring the reference
+tests' save/load half.
+
+recognize_digits lives in tests/test_book_mnist.py; image_classification
+(CIFAR conv net) and the rest are here.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.executor import Trainer, supervised_loss
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.data import datasets, readers
+from paddle_tpu.metrics import accuracy
+from paddle_tpu.nn import Conv2D, Linear, max_pool2d
+from paddle_tpu.ops import functional as F
+from paddle_tpu.ops.lattice import crf_decoding, linear_chain_crf
+from paddle_tpu.optim.optimizer import Adam
+from paddle_tpu.models.nlp import (Recommender, Seq2Seq, TextClassifier,
+                                   Word2Vec)
+
+
+def _first_last(trainer, ts, batches, epochs=1, rngkey=0):
+    first = last = None
+    for ep in range(epochs):
+        for b in batches:
+            ts, fetches = trainer.train_step(ts, b)
+            if first is None:
+                first = float(fetches["loss"])
+    return ts, first, float(fetches["loss"])
+
+
+def test_fit_a_line(tmp_path):
+    """Linear regression on uci_housing (test_fit_a_line.py) + inference
+    export round-trip."""
+    model = Linear(1)
+    loss_fn = supervised_loss(
+        lambda pred, y: F.square_error_cost(pred, y.reshape(pred.shape)))
+    trainer = Trainer(model, Adam(1e-1), loss_fn)
+    raw = list(readers.batch(datasets.uci_housing_train(), 64)())
+    # standardize features (the reference dataset ships pre-normalized)
+    allx = np.concatenate([b[0] for b in raw])
+    mu, sd = allx.mean(0), allx.std(0) + 1e-6
+    batches = [((b[0] - mu) / sd, b[1]) for b in raw]
+    ts = trainer.init_state(jnp.zeros((64, 13)))
+    ts, first, last = _first_last(trainer, ts, batches, epochs=60)
+    assert last < first * 0.5, (first, last)
+
+    from paddle_tpu.io.inference import (InferencePredictor,
+                                         save_inference_model)
+    path = str(tmp_path / "fit_a_line")
+    save_inference_model(path, model, ts.variables,
+                         [jnp.zeros((64, 13))], input_names=["x"])
+    pred = InferencePredictor(path)
+    x = batches[0][0]
+    out = pred.run({"x": x})[0]
+    want = model.apply(ts.variables, jnp.asarray(x))
+    np.testing.assert_allclose(out, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_image_classification_cifar():
+    """Small conv net on cifar10 (test_image_classification.py)."""
+    class SmallConv(Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = Conv2D(32, 3, padding="SAME")
+            self.c2 = Conv2D(64, 3, padding="SAME")
+            self.fc = Linear(10)
+
+        def forward(self, cx: Context, x):
+            x = max_pool2d(F.relu(self.c1(cx, x)), 2, 2)
+            x = max_pool2d(F.relu(self.c2(cx, x)), 2, 2)
+            return self.fc(cx, x.reshape(x.shape[0], -1))
+
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y),
+        metrics={"acc": accuracy})
+    trainer = Trainer(SmallConv(), Adam(1e-3), loss_fn)
+    batches = list(readers.batch(
+        datasets.cifar10_train(synthetic_n=256), 64)())
+    ts = trainer.init_state(jnp.zeros((64, 32, 32, 3)))
+    ts, first, last = _first_last(trainer, ts, batches, epochs=4)
+    assert last < first, (first, last)
+
+
+def test_word2vec():
+    """N-gram CBOW on imikolov (test_word2vec.py)."""
+    vocab = 256
+    model = Word2Vec(vocab=vocab, embed_dim=16, hidden=64, context=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y))
+    trainer = Trainer(model, Adam(5e-3), loss_fn)
+    batches = list(readers.batch(
+        datasets.imikolov_ngram_train(vocab=vocab, synthetic_n=512), 64)())
+    ts = trainer.init_state(jnp.zeros((64, 4), jnp.int32))
+    ts, first, last = _first_last(trainer, ts, batches, epochs=6)
+    assert last < first * 0.9, (first, last)
+
+
+def test_recommender_system():
+    """Dual-tower recommender on movielens (test_recommender_system.py)."""
+    model = Recommender(num_users=128, num_items=64, embed_dim=16)
+
+    def loss_fn(module, variables, batch, rng, training):
+        u, m, r = batch
+        pred, mut = module.apply(variables, u, m, training=training,
+                                 rngs=rng, mutable=True)
+        loss = jnp.mean(F.square_error_cost(pred, r))
+        return (loss, {}), mut.get("state", {})
+
+    trainer = Trainer(model, Adam(5e-3), loss_fn)
+    rows = list(datasets.movielens_train(num_users=128, num_movies=64,
+                                         synthetic_n=512)())
+    batches = []
+    for i in range(0, len(rows) - 64 + 1, 64):
+        chunk = rows[i:i + 64]
+        batches.append((np.stack([c[0] for c in chunk]),
+                        np.stack([c[4] for c in chunk]),
+                        np.stack([c[6] for c in chunk])))
+    ts = trainer.init_state(jnp.zeros((64,), jnp.int32),
+                            jnp.zeros((64,), jnp.int32))
+    ts, first, last = _first_last(trainer, ts, batches, epochs=8)
+    assert last < first * 0.8, (first, last)
+
+
+def test_label_semantic_roles_crf():
+    """BiLSTM-free CRF tagger on conll05 (test_label_semantic_roles.py):
+    embeddings + projection + linear-chain CRF, decoded with viterbi."""
+    vocab, nlab, seqlen = 200, 9, 16
+
+    class SRL(Module):
+        def __init__(self):
+            super().__init__()
+            from paddle_tpu.nn import Embedding
+            self.embed = Embedding(vocab, 32)
+            self.mark_embed = Embedding(2, 8)
+            self.fc = Linear(64)
+            self.emit = Linear(nlab)
+
+        def forward(self, cx: Context, words, mark):
+            h = jnp.concatenate([self.embed(cx, words),
+                                 self.mark_embed(cx, mark)], axis=-1)
+            h = F.relu(self.fc(cx, h))
+            return self.emit(cx, h)
+
+    model = SRL()
+
+    def loss_fn(module, variables, batch, rng, training):
+        words, mark, lengths, labels = batch
+        emit, mut = module.apply(variables, words, mark, training=training,
+                                 rngs=rng, mutable=True)
+        trans = variables["params"].get("crf_transitions")
+        if trans is None:
+            trans = jnp.zeros((nlab + 2, nlab))
+        nll = linear_chain_crf(emit, labels, trans, lengths)
+        return (jnp.mean(nll), {}), mut.get("state", {})
+
+    # CRF transitions ride in the params tree as an extra trainable leaf
+    trainer = Trainer(model, Adam(5e-3), loss_fn)
+    ts = trainer.init_state(jnp.zeros((4, seqlen), jnp.int32),
+                            jnp.zeros((4, seqlen), jnp.int32))
+    from paddle_tpu.core.executor import TrainState
+    params = dict(ts.params)
+    params["crf_transitions"] = jnp.zeros((nlab + 2, nlab))
+    ts = TrainState(params, ts.state, trainer.optimizer.init(params),
+                    ts.step)
+
+    rows = list(datasets.conll05_train(vocab=vocab, num_labels=nlab,
+                                       seq_len=seqlen,
+                                       synthetic_n=256)())
+    batches = []
+    for i in range(0, len(rows) - 32 + 1, 32):
+        chunk = rows[i:i + 32]
+        batches.append(tuple(np.stack([c[j] for c in chunk])
+                             for j in range(4)))
+    first = last = None
+    for ep in range(6):
+        for b in batches:
+            ts, fetches = trainer.train_step(ts, b)
+            if first is None:
+                first = float(fetches["loss"])
+    last = float(fetches["loss"])
+    assert last < first * 0.9, (first, last)
+
+    # viterbi decode runs and respects lengths
+    words, mark, lengths, labels = batches[0]
+    emit = model.apply({"params": {k: v for k, v in ts.params.items()
+                                   if k != "crf_transitions"}},
+                       jnp.asarray(words), jnp.asarray(mark))
+    path = crf_decoding(emit, ts.params["crf_transitions"],
+                        jnp.asarray(lengths))
+    if isinstance(path, tuple):
+        path = path[0]
+    assert path.shape == words.shape
+
+
+def test_rnn_encoder_decoder_machine_translation():
+    """GRU attention seq2seq on synthetic WMT (test_machine_translation.py
+    + test_rnn_encoder_decoder.py)."""
+    sv = tv = 64
+    model = Seq2Seq(src_vocab=sv, trg_vocab=tv, embed_dim=16, hidden=32)
+
+    def loss_fn(module, variables, batch, rng, training):
+        src, trg_in, trg_out = batch
+        logits, mut = module.apply(variables, src, trg_in,
+                                   training=training, rngs=rng,
+                                   mutable=True)
+        loss = jnp.mean(F.softmax_with_cross_entropy(logits, trg_out))
+        return (loss, {}), mut.get("state", {})
+
+    trainer = Trainer(model, Adam(5e-3), loss_fn)
+    rows = list(datasets.wmt_synthetic(src_vocab=sv, trg_vocab=tv,
+                                       seq_len=10, synthetic_n=256)())
+    batches = []
+    for i in range(0, len(rows) - 32 + 1, 32):
+        chunk = rows[i:i + 32]
+        src = np.stack([c[0] for c in chunk])
+        trg = np.stack([c[2] for c in chunk])   # rows are (src, len, trg)
+        batches.append((src, trg[:, :-1], trg[:, 1:]))
+    ts = trainer.init_state(jnp.zeros((32, 10), jnp.int32),
+                            jnp.zeros((32, 9), jnp.int32))
+    first = last = None
+    for ep in range(6):
+        for b in batches:
+            ts, fetches = trainer.train_step(ts, b)
+            if first is None:
+                first = float(fetches["loss"])
+    last = float(fetches["loss"])
+    assert last < first * 0.9, (first, last)
+
+
+def test_understand_sentiment():
+    """Stacked-LSTM sentiment on the sentiment reader
+    (notest_understand_sentiment.py chapter)."""
+    vocab = 200
+    model = TextClassifier(vocab=vocab, embed_dim=16, hidden=32, layers=1)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y),
+        metrics={"acc": accuracy})
+    trainer = Trainer(model, Adam(5e-3), loss_fn)
+    rows = list(datasets.sentiment_train(vocab=vocab, seq_len=24,
+                                         synthetic_n=256)())
+    batches = []
+    for i in range(0, len(rows) - 32 + 1, 32):
+        chunk = rows[i:i + 32]
+        toks = np.stack([c[0] for c in chunk])
+        y = np.stack([c[2] for c in chunk])
+        batches.append((toks, y))
+    ts = trainer.init_state(jnp.zeros((32, 24), jnp.int32))
+    ts, first, last = _first_last(trainer, ts, batches, epochs=4)
+    assert last < first * 0.95, (first, last)

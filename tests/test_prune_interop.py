@@ -1,0 +1,124 @@
+"""Pruning (contrib.slim prune capability) and DLPack interop
+(framework/dlpack_tensor) tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.executor import Trainer, supervised_loss
+from paddle_tpu.metrics import accuracy
+from paddle_tpu.models import MLP
+from paddle_tpu.ops import functional as F
+from paddle_tpu.optim.optimizer import Adam
+from paddle_tpu.quant.prune import (apply_masks, magnitude_masks,
+                                    masked_train_step, select_ratios,
+                                    sensitivity_analysis, sparsity)
+
+
+def _trained_mlp(rng_seed=0, steps=40):
+    model = MLP(hidden=(32,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y),
+        metrics={"acc": accuracy})
+    tr = Trainer(model, Adam(5e-2), loss_fn)
+    rs = np.random.RandomState(rng_seed)
+    w = rs.randn(8, 4)
+    x = rs.randn(128, 8).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.int64)
+    ts = tr.init_state(jnp.zeros((128, 8)))
+    for _ in range(steps):
+        ts, f = tr.train_step(ts, (x, y))
+    return model, tr, ts, (x, y), f
+
+
+def test_magnitude_masks_hit_ratio():
+    _, _, ts, _, _ = _trained_mlp()
+    masks = magnitude_masks(ts.params, 0.5)
+    s = sparsity(masks)
+    assert 0.45 <= s <= 0.55
+    pruned = apply_masks(ts.params, masks)
+    for (p_key, p), (m_key, m) in zip(
+            jax.tree_util.tree_flatten_with_path(pruned)[0],
+            jax.tree_util.tree_flatten_with_path(masks)[0]):
+        assert np.all((np.asarray(p) == 0) | (np.asarray(m) == 1))
+
+
+def test_channel_pruning_zeroes_whole_columns():
+    _, _, ts, _, _ = _trained_mlp()
+    masks = magnitude_masks(ts.params, 0.5, granularity="channel")
+    flat = [(k, m) for k, m in
+            [("/".join(str(getattr(p, 'key', p)) for p in path), leaf)
+             for path, leaf in
+             jax.tree_util.tree_flatten_with_path(masks)[0]]
+            if k.endswith("weight")]
+    for k, m in flat:
+        m = np.asarray(m)
+        col = m.reshape(-1, m.shape[-1])
+        # every output channel is entirely kept or entirely dropped
+        assert np.all((col.min(0) == col.max(0)))
+
+
+def test_prune_finetune_recovers_accuracy():
+    model, tr, ts, (x, y), f0 = _trained_mlp()
+    masks = magnitude_masks(ts.params, 0.5)
+    from paddle_tpu.core.executor import TrainState
+    ts_p = TrainState(apply_masks(ts.params, masks), ts.state,
+                      ts.opt_state, ts.step)
+    step = masked_train_step(tr, masks)
+    for _ in range(30):
+        ts_p, f = step(ts_p, (x, y))
+    # masks still enforced after fine-tune
+    assert sparsity(magnitude_masks(ts_p.params, 0.0)) == 0.0  # sanity
+    w = ts_p.params["fcs_0"]["weight"]
+    m = masks["fcs_0"]["weight"]
+    assert np.all(np.asarray(w)[np.asarray(m) == 0] == 0)
+    assert float(f["acc"]) > 0.8
+
+
+def test_sensitivity_and_ratio_selection():
+    model, tr, ts, (x, y), _ = _trained_mlp()
+
+    def eval_loss(params):
+        out = model.apply({"params": params}, jnp.asarray(x))
+        return float(jnp.mean(F.softmax_with_cross_entropy(
+            out, jnp.asarray(y))))
+
+    sens = sensitivity_analysis(eval_loss, ts.params, ratios=(0.3, 0.9))
+    assert sens                      # found prunable layers
+    for path, per in sens.items():
+        assert per[0.9] >= per[0.0] - 1e-6   # more pruning, no better loss
+    chosen = select_ratios(sens, budget=1e9)
+    assert all(r == 0.9 for r in chosen.values())   # infinite budget
+    chosen_tight = select_ratios(sens, budget=0.0)
+    assert all(r in (0.0, 0.3, 0.9) for r in chosen_tight.values())
+
+
+def test_dlpack_torch_roundtrip():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.interop import (from_torch, to_torch,
+                                          tree_from_torch)
+    x = jnp.arange(12.0).reshape(3, 4)
+    t = to_torch(x)
+    assert tuple(t.shape) == (3, 4)
+    back = from_torch(t)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+    tree = tree_from_torch({"a": torch.ones(2, 2), "b": 3})
+    assert isinstance(tree["a"], jax.Array) and tree["b"] == 3
+
+
+def test_to_dlpack_capsule():
+    from paddle_tpu.utils.interop import to_dlpack
+    cap = to_dlpack(jnp.ones((2, 2)))
+    assert "dltensor" in repr(cap)
+
+
+def test_sensitivity_prunes_only_target_layer():
+    """Anchored matching: one layer's sensitivity probe must not prune a
+    layer whose path merely shares a suffix."""
+    w = jnp.arange(16.0).reshape(4, 4) + 1.0
+    params = {"fc": {"weight": w}, "head": {"fc": {"weight": w}}}
+    import re
+    masks = magnitude_masks(params, {re.escape("fc/weight"): 0.5})
+    assert float(jnp.sum(masks["fc"]["weight"])) == 8
+    assert float(jnp.sum(masks["head"]["fc"]["weight"])) == 16

@@ -91,3 +91,27 @@ def test_cpp_demo_binary(model_dir):
     x = (np.arange(24) % 100 / 100.0).astype(np.float32).reshape(4, 6)
     py_sum = float(InferencePredictor(model_dir).run([x])[0].sum())
     assert abs(c_sum - py_sum) < 1e-4 * max(1.0, abs(py_sum))
+
+
+def test_cpp_train_demo(tmp_path):
+    """Native C++ trainer demo (reference train/demo/demo_trainer.cc +
+    test_train_recognize_digits.cc): the C++ binary owns the loop, the
+    loss falls, and a checkpoint is committed."""
+    from paddle_tpu.serving import build_train_demo
+    demo = build_train_demo()
+    assert demo is not None, "train demo must compile (g++ is in image)"
+
+    ckpt = str(tmp_path / "cpp_ckpt")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [demo, f"{REPO}:{_site_packages()}", ckpt],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, \
+        f"train demo failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "TRAIN DEMO OK" in proc.stdout
+    # the checkpoint the C++ app requested exists and loads
+    from paddle_tpu.io.checkpoint import load_checkpoint
+    tree = load_checkpoint(ckpt)
+    assert "params" in tree and "opt" in tree
