@@ -31,10 +31,13 @@ from paddle_tpu.profiler.profiler import (
     stop_profiler,
 )
 from paddle_tpu.profiler.timeline import Timeline, merge_profiles
+from paddle_tpu.profiler.device_trace import (
+    OpRow, device_trace, format_table, op_table)
 
 __all__ = [
     "RecordEvent", "annotate", "events_to_chrome_trace", "get_events",
     "profile_table", "profiler", "record_event", "record_function",
     "reset_profiler", "save_profile", "start_profiler", "stop_profiler",
     "Timeline", "merge_profiles",
+    "OpRow", "device_trace", "format_table", "op_table",
 ]

@@ -105,9 +105,9 @@ def masked_train_step(trainer, masks: Pytree):
     step(ts, batch, rng) callable."""
     def step(ts, batch, rng=None):
         new_ts, fetches = trainer.train_step(ts, batch, rng=rng)
-        new_ts.params.update(apply_masks(
-            {k: new_ts.params[k] for k in new_ts.params}, masks))
-        return new_ts, fetches
+        masked = type(new_ts)(apply_masks(new_ts.params, masks),
+                              new_ts.state, new_ts.opt_state, new_ts.step)
+        return masked, fetches
     return step
 
 

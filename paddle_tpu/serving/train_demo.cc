@@ -85,16 +85,18 @@ bool run(const std::string& sys_path, const std::string& ckpt_dir) {
   PyObject* mod = PyImport_AddModule("__main__");  // borrowed
   PyObject* g = PyModule_GetDict(mod);             // borrowed
 
-  // sys.path entries (colon-separated)
+  // sys.path entries (colon-separated); inserted at increasing indices so
+  // the caller's order is preserved (first entry wins imports)
   PyObject* sys_path_list = PySys_GetObject("path");  // borrowed
   size_t start = 0;
+  Py_ssize_t insert_at = 0;
   while (start <= sys_path.size()) {
     size_t end = sys_path.find(':', start);
     if (end == std::string::npos) end = sys_path.size();
     std::string piece = sys_path.substr(start, end - start);
     if (!piece.empty()) {
       PyObject* p = PyUnicode_FromString(piece.c_str());
-      PyList_Insert(sys_path_list, 0, p);
+      PyList_Insert(sys_path_list, insert_at++, p);
       Py_DECREF(p);
     }
     start = end + 1;

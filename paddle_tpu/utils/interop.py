@@ -20,13 +20,18 @@ def to_dlpack(x):
     """jax.Array -> DLPack capsule (zero-copy where the consumer allows).
 
     Uses the array's standard __dlpack__ protocol (jax.dlpack.to_dlpack
-    was removed in newer jax)."""
+    was removed in newer jax). Consumers that only accept protocol
+    objects (e.g. jax's own from_dlpack) should be handed the array
+    itself, not this capsule."""
     return x.__dlpack__()
 
 
-def from_dlpack(capsule_or_tensor):
-    """DLPack capsule or any __dlpack__-bearing object -> jax.Array."""
-    return jax.dlpack.from_dlpack(capsule_or_tensor)
+def from_dlpack(tensor):
+    """Any __dlpack__-bearing object (torch/np/jax array) -> jax.Array.
+
+    Note: newer jax only accepts protocol objects, not raw capsules —
+    pass the producer's array/tensor directly."""
+    return jax.dlpack.from_dlpack(tensor)
 
 
 def to_torch(x):

@@ -106,3 +106,61 @@ def test_device_trace_capture(tmp_path):
     for root, _, files in os.walk(trace_dir):
         found += files
     assert found, "jax.profiler produced no trace files"
+
+
+class TestDeviceTrace:
+    """Device-tier op tables (reference device_tracer.h + EnableProfiler
+    table). A synthetic Chrome trace stands in for hardware; on TPU the
+    same parser consumes jax.profiler.start_trace output."""
+
+    def _fake_trace(self, tmp_path):
+        import gzip, json, os
+        d = tmp_path / "plugins" / "profile" / "run1"
+        os.makedirs(d)
+        events = [
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 0, "dur": 1000,
+             "name": "fusion.1",
+             "args": {"hlo_category": "convolution fusion",
+                      "bytes_accessed": "1000000", "model_flops": "2000000"}},
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 1000, "dur": 500,
+             "name": "fusion.2",
+             "args": {"hlo_category": "loop fusion",
+                      "bytes_accessed": "500000", "model_flops": "0"}},
+            {"ph": "X", "pid": 3, "tid": 3, "ts": 1500, "dur": 1000,
+             "name": "fusion.1",
+             "args": {"hlo_category": "convolution fusion",
+                      "bytes_accessed": "1000000", "model_flops": "2000000"}},
+            {"ph": "M", "pid": 3, "name": "process_name",
+             "args": {"name": "TPU"}},
+        ]
+        with gzip.open(d / "host.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        return str(tmp_path)
+
+    def test_op_table_by_category(self, tmp_path):
+        from paddle_tpu.profiler.device_trace import format_table, op_table
+        rows = op_table(self._fake_trace(tmp_path), steps=2)
+        assert rows[0].name == "convolution fusion"
+        assert rows[0].total_ms == 1.0           # 2000us / 2 steps
+        assert rows[0].count == 1
+        assert rows[0].gbps > 0 and rows[0].tflops > 0
+        assert rows[1].name == "loop fusion"
+        txt = format_table(rows)
+        assert "convolution fusion" in txt and "total device time" in txt
+
+    def test_op_table_by_op(self, tmp_path):
+        from paddle_tpu.profiler.device_trace import op_table
+        rows = op_table(self._fake_trace(tmp_path), by="op", steps=1)
+        names = [r.name for r in rows]
+        assert names == ["fusion.1", "fusion.2"]
+
+    def test_device_trace_contextmanager_on_cpu(self, tmp_path):
+        import jax, jax.numpy as jnp
+        from paddle_tpu.profiler.device_trace import device_trace
+        with device_trace(str(tmp_path / "tr")):
+            y = jax.jit(lambda x: x @ x)(jnp.ones((64, 64)))
+            jax.block_until_ready(y)
+        # CPU traces may not carry hlo_category events; the capture
+        # itself must at least produce a trace directory
+        import glob
+        assert glob.glob(str(tmp_path / "tr") + "/**/*", recursive=True)

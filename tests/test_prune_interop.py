@@ -111,6 +111,18 @@ def test_to_dlpack_capsule():
     from paddle_tpu.utils.interop import to_dlpack
     cap = to_dlpack(jnp.ones((2, 2)))
     assert "dltensor" in repr(cap)
+    # the capsule is consumable by a protocol consumer (numpy >= 1.23)
+    torch = pytest.importorskip("torch")
+    t = torch.utils.dlpack.from_dlpack(to_dlpack(jnp.ones((2, 2))))
+    assert tuple(t.shape) == (2, 2)
+
+
+def test_from_dlpack_protocol_object():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.utils.interop import from_dlpack
+    arr = from_dlpack(torch.arange(6.0).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(arr),
+                               np.arange(6.0).reshape(2, 3))
 
 
 def test_sensitivity_prunes_only_target_layer():
