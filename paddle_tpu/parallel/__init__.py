@@ -15,5 +15,6 @@ from paddle_tpu.parallel.moe import (
     init_moe_params, load_balancing_loss, moe_ffn, moe_partition_specs,
 )
 from paddle_tpu.parallel.ring import (
-    ring_attention, ring_flash_attention, zigzag_shard, zigzag_unshard,
+    ring_attention, ring_flash_attention, ulysses_attention, zigzag_shard,
+    zigzag_unshard,
 )

@@ -432,3 +432,59 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "sp",
 
     return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses-style sequence parallelism (SURVEY §7 M8 "head-sharding
+# alternative"): instead of rotating K/V around a ring, all_to_alls
+# reshape the sharding — tokens-sharded [B, T/sp, H, D] becomes
+# heads-sharded [B, T, H/sp, D], each device runs FULL attention over its
+# head group (flash kernel, no cross-device softmax state), and the
+# output is all_to_all'd back. Communication is 4 all_to_alls of the
+# activations (q/k/v in, o out) vs the ring's sp-1 K/V ppermutes; sp must
+# divide the head count. Preferable to the ring when heads >= sp and the
+# full sequence fits per-device memory after head partitioning.
+# ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      scale: Optional[float] = None, causal: bool = False,
+                      interpret: Optional[bool] = None):
+    """All-to-all sequence parallelism. q/k/v: [B, T, H, D] sharded on T
+    over `axis`; H % mesh.shape[axis] == 0. Returns [B, T, H, D] with the
+    same sharding. Differentiable (all_to_all is linear; jax autodiff
+    transposes it)."""
+    d = q.shape[-1]
+    h = q.shape[2]
+    sp = mesh.shape[axis]
+    if h % sp != 0:
+        raise ValueError(f"heads {h} not divisible by sp axis {sp}")
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    spec = P(None, axis, None, None)
+
+    def local_fn(q_l, k_l, v_l):
+        # [B, T/sp, H, D] -> all_to_all over heads -> [B, T, H/sp, D]
+        def seq_to_heads(x):
+            # split heads into sp groups along axis 2, concat seq chunks
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def heads_to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        qh = seq_to_heads(q_l)          # [B, T, H/sp, D]
+        kh = seq_to_heads(k_l)
+        vh = seq_to_heads(v_l)
+        from paddle_tpu.kernels import flash as FL
+        t = qh.shape[1]
+        bq, bk = _blk_sizes(t, t, interpret)
+        b, _, hh, _ = qh.shape
+        o = FL._flash_core(_to_bhtd(qh), _to_bhtd(kh), _to_bhtd(vh),
+                           scale, causal, None, bq, bk, interpret)
+        o = _from_bhtd(o, b, hh)
+        return heads_to_seq(o)          # [B, T/sp, H, D]
+
+    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)

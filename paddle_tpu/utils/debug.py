@@ -69,6 +69,16 @@ def dump_hlo(fn: Callable, *args, stage: str = "stablehlo",
                      "use jaxpr | stablehlo | optimized")
 
 
+def _unwrap_params(variables: Optional[Dict]) -> Dict:
+    """Accept a full variables dict or a bare params tree."""
+    return (variables or {}).get("params", variables) or {}
+
+
+def _count_params(p: Any) -> int:
+    return sum(getattr(v, "size", 0) for v in jax.tree.leaves(
+        p if isinstance(p, dict) else {}))
+
+
 def module_tree(module: Module, variables: Optional[Dict] = None,
                 _name: str = "", _indent: int = 0) -> str:
     """Pretty-print a module hierarchy with parameter shapes/counts.
@@ -77,13 +87,12 @@ def module_tree(module: Module, variables: Optional[Dict] = None,
     debugger.py's block dump, at module granularity.
     """
     lines: List[str] = []
-    params = (variables or {}).get("params", variables) or {}
+    params = _unwrap_params(variables)
 
     def walk(m: Module, name: str, p: Any, indent: int):
         own = {k: v for k, v in (p or {}).items()
                if not isinstance(v, dict)} if isinstance(p, dict) else {}
-        n_params = sum(getattr(v, "size", 0) for v in jax.tree.leaves(
-            p if isinstance(p, dict) else {}))
+        n_params = _count_params(p)
         head = "  " * indent + (name or type(m).__name__)
         desc = type(m).__name__
         extra = f" params={n_params:,}" if n_params else ""
@@ -96,4 +105,36 @@ def module_tree(module: Module, variables: Optional[Dict] = None,
             walk(child, cname, cp, indent + 1)
 
     walk(module, _name, params, _indent)
+    return "\n".join(lines)
+
+
+def module_tree_dot(module: Module, variables: Optional[Dict] = None) -> str:
+    """Graphviz dot source for a module hierarchy.
+
+    ≈ the reference's graph visualizers (ir/graph_viz_pass.cc dot dump,
+    python net_drawer.py / debugger.draw_block_graphviz): render with
+    `dot -Tpng` or any online viewer. Node labels carry the module class
+    and parameter counts.
+    """
+    params = _unwrap_params(variables)
+    lines = ["digraph module_tree {",
+             '  node [shape=box, fontname="monospace", fontsize=10];']
+    counter = [0]
+
+    def walk(m: Module, name: str, p: Any) -> str:
+        nid = f"n{counter[0]}"
+        counter[0] += 1
+        n_params = _count_params(p)
+        label = f"{name or type(m).__name__}\\n{type(m).__name__}"
+        if n_params:
+            label += f"\\nparams={n_params:,}"
+        lines.append(f'  {nid} [label="{label}"];')
+        for cname, child in m.children().items():
+            cp = p.get(cname) if isinstance(p, dict) else None
+            cid = walk(child, cname, cp)
+            lines.append(f"  {nid} -> {cid};")
+        return nid
+
+    walk(module, "", params)
+    lines.append("}")
     return "\n".join(lines)

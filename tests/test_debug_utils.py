@@ -42,3 +42,15 @@ def test_module_tree_printer():
     assert "params=" in txt
     # weight shapes shown
     assert "(5, 5, 1, 20)" in txt
+
+
+def test_module_tree_dot():
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.utils.debug import module_tree_dot
+    m = LeNet(num_classes=10)
+    variables = m.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))
+    dot = module_tree_dot(m, variables)
+    assert dot.startswith("digraph")
+    assert "LeNet" in dot and "conv1" in dot
+    assert "->" in dot and dot.rstrip().endswith("}")
+    assert "params=" in dot
