@@ -133,6 +133,17 @@ def main():
     except Exception as e:  # primary metric must still print
         extra["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    if on_tpu:  # inference throughput (reference publishes infer tables)
+        try:
+            from paddle_tpu.benchmark.models import run_infer
+            inf = run_infer("resnet50", batch_size=16, dtype=dtype,
+                            min_time=min_time)
+            extra["resnet50_infer_imgs_per_sec_bs16"] = round(inf.value, 1)
+            extra["resnet50_infer_vs_baseline"] = (
+                round(inf.vs_baseline, 1) if inf.vs_baseline else None)
+        except Exception as e:
+            extra["infer_error"] = f"{type(e).__name__}: {e}"[:160]
+
     if on_tpu:  # flash kernel on-hardware correctness gate
         try:
             from paddle_tpu.kernels.selfcheck import flash_selfcheck

@@ -40,6 +40,9 @@ def main(argv=None) -> int:
     p.add_argument("--gradient_accumulation", type=int, default=1)
     p.add_argument("--json", action="store_true",
                    help="one JSON object per line instead of a table")
+    p.add_argument("--infer", action="store_true",
+                   help="inference throughput (eval forward) instead of "
+                        "training; mirrors the reference's infer tables")
     p.add_argument("--scaling", default=None, metavar="SIZES",
                    help="weak-scaling sweep over dp mesh sizes, e.g. "
                         "'1,2,4,8': per-chip throughput + efficiency "
@@ -68,6 +71,11 @@ def main(argv=None) -> int:
                       f"[{row['platform']}]")
         return 0
 
+    if args.infer and (args.dp or args.fsdp or args.tp
+                       or args.gradient_accumulation != 1):
+        p.error("--infer benchmarks single-device eval throughput; "
+                "mesh/accumulation flags do not apply")
+
     mesh = strategy = rules = None
     if args.dp or args.fsdp or args.tp:
         from paddle_tpu.parallel import DistStrategy, MeshConfig, make_mesh
@@ -87,9 +95,17 @@ def main(argv=None) -> int:
 
     results = []
     for name in names:
-        r = run_model(name, batch_size=args.batch_size, dtype=dtype,
-                      mesh=mesh, strategy=strategy, rules=rules,
-                      min_time=args.min_time)
+        if args.infer:
+            from paddle_tpu.benchmark.models import INFER_MODELS, run_infer
+            if name not in INFER_MODELS:
+                print(f"{name:>14}  (no inference benchmark; skipped)")
+                continue
+            r = run_infer(name, batch_size=args.batch_size or 16,
+                          dtype=dtype, min_time=args.min_time)
+        else:
+            r = run_model(name, batch_size=args.batch_size, dtype=dtype,
+                          mesh=mesh, strategy=strategy, rules=rules,
+                          min_time=args.min_time)
         results.append(r)
         if args.json:
             print(json.dumps(r.to_dict()))

@@ -200,3 +200,61 @@ def run_model(name: str, batch_size: Optional[int] = None,
                          f"choose from {sorted(MODELS)}")
     return MODELS[name](name, batch_size, dtype, mesh, strategy, rules,
                         min_time)
+
+
+# Published reference INFERENCE numbers (BASELINE.md: Xeon E5-2650v4,
+# MKL-DNN): imgs/s at the listed batch size.
+INFER_BASELINES = {
+    ("resnet50", 1): 107.83,
+    ("resnet50", 16): 217.69,
+    ("googlenet", 16): 600.94,
+    ("alexnet", 16): 850.51,
+    ("vgg16", 1): 75.07,        # VGG-19 figure; closest published
+}
+
+def _infer_models():
+    from paddle_tpu.models import vision as V
+    return {
+        "resnet50": lambda d: V.resnet50(1000, dtype=d),
+        "googlenet": lambda d: V.GoogLeNet(1000, dtype=d),
+        "alexnet": lambda d: V.AlexNet(1000, dtype=d),
+        "vgg16": lambda d: V.vgg16(1000, dtype=d),
+    }
+
+
+INFER_MODELS = ("alexnet", "googlenet", "resnet50", "vgg16")
+
+
+def run_infer(name: str, batch_size: int = 16, dtype=jnp.float32,
+              min_time: float = 2.0, img: int = 224) -> BenchResult:
+    """Inference throughput (reference IntelOptimizedPaddle.md infer
+    table; served-model path: eval-mode forward, no grads)."""
+    from paddle_tpu.benchmark.harness import (compiled_flops,
+                                              device_peak_flops, run_timed)
+    ctors = _infer_models()
+    if name not in ctors:
+        raise ValueError(f"unknown infer model {name!r}; "
+                         f"choose from {sorted(ctors)}")
+    model = ctors[name](dtype)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch_size, img, img, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x)
+    fwd = jax.jit(lambda v, xx: model.apply(v, xx, training=False))
+
+    def step(s):
+        return s, fwd(variables, x)
+
+    sec, steps, _ = run_timed(step, None, min_time=min_time)
+    flops = compiled_flops(fwd, variables, x)
+    peak = device_peak_flops()
+    baseline = INFER_BASELINES.get((name, batch_size))
+    value = batch_size / sec
+    return BenchResult(
+        model=f"{name}_infer", unit="imgs/s", value=value,
+        ms_per_step=sec * 1e3, steps=steps, batch_size=batch_size,
+        flops_per_step=flops,
+        tflops_per_sec=(flops / sec / 1e12) if flops else None,
+        mfu=(flops / sec / peak) if (flops and peak) else None,
+        device=getattr(jax.devices()[0], "device_kind",
+                       jax.devices()[0].platform),
+        vs_baseline=(value / baseline) if baseline else None)

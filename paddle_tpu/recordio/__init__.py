@@ -15,8 +15,9 @@ API:
 """
 
 from paddle_tpu.recordio.recordio import (
-    Scanner, Writer, count, native_available, recordio_reader,
-    write_recordio)
+    PrefetchScanner, Scanner, Writer, count, native_available,
+    prefetch_reader, recordio_reader, write_recordio)
 
-__all__ = ["Scanner", "Writer", "count", "native_available",
-           "recordio_reader", "write_recordio"]
+__all__ = ["PrefetchScanner", "Scanner", "Writer", "count",
+           "native_available", "prefetch_reader", "recordio_reader",
+           "write_recordio"]

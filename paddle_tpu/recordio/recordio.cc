@@ -28,7 +28,12 @@
 #include <string.h>
 #include <zlib.h>
 
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -137,6 +142,21 @@ bool load_chunk(Scanner* s) {
   return true;
 }
 
+struct Prefetcher {
+  std::vector<std::string> paths;
+  std::deque<std::vector<uint8_t>> queue;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  size_t capacity = 1024;
+  int active = 0;
+  bool closing = false;
+  std::string error;            // written by workers under mu
+  std::string error_out;        // consumer-owned snapshot (see _error)
+  std::vector<std::thread> threads;
+  std::atomic<size_t> next_path{0};
+  std::vector<uint8_t> current;
+};
+
 }  // namespace
 
 extern "C" {
@@ -228,6 +248,105 @@ int64_t rio_count(const char* path) {
   }
   fclose(f);
   return total;
+}
+
+// ---- multi-file background prefetcher ----
+// The reference's async reader tier (operators/reader/open_files_op.cc
+// multi-file parallel reader, buffered_reader.h double buffering,
+// ctr_reader.h dedicated reader threads): N worker threads scan a list
+// of recordio files and push records into a bounded queue; the consumer
+// pops without touching the filesystem. Single-consumer contract (the
+// popped record stays valid until the next rio_prefetch_next call).
+
+void* rio_prefetch_open(const char** paths, int n_paths, int n_threads,
+                        int queue_capacity) {
+  Prefetcher* p = new Prefetcher();
+  for (int i = 0; i < n_paths; i++) p->paths.emplace_back(paths[i]);
+  p->capacity = queue_capacity > 0 ? (size_t)queue_capacity : 1024;
+  int nt = n_threads > 0 ? n_threads : 2;
+  if (nt > n_paths) nt = n_paths;
+  p->active = nt;
+  for (int t = 0; t < nt; t++) {
+    p->threads.emplace_back([p]() {
+      for (;;) {
+        size_t idx = p->next_path.fetch_add(1);
+        if (idx >= p->paths.size()) break;
+        void* sc = rio_scanner_open(p->paths[idx].c_str());
+        if (!sc) {
+          std::lock_guard<std::mutex> g(p->mu);
+          if (p->error.empty())
+            p->error = "cannot open " + p->paths[idx];
+          p->cv_pop.notify_all();
+          break;
+        }
+        const uint8_t* rec = nullptr;
+        int64_t len;
+        while ((len = rio_next(sc, &rec)) >= 0) {
+          std::unique_lock<std::mutex> g(p->mu);
+          p->cv_push.wait(g, [p] {
+            return p->queue.size() < p->capacity || p->closing;
+          });
+          if (p->closing) {
+            g.unlock();
+            rio_scanner_close(sc);
+            goto done;
+          }
+          p->queue.emplace_back(rec, rec + len);
+          p->cv_pop.notify_one();
+        }
+        if (len == -2) {
+          std::lock_guard<std::mutex> g(p->mu);
+          if (p->error.empty())
+            p->error = std::string("corrupt file ") + p->paths[idx] +
+                       ": " + rio_error(sc);
+        }
+        rio_scanner_close(sc);
+      }
+    done:
+      std::lock_guard<std::mutex> g(p->mu);
+      if (--p->active == 0) p->cv_pop.notify_all();
+    });
+  }
+  return p;
+}
+
+// Returns record length >= 0 (record in *out, valid until next call),
+// -1 when all files are exhausted, -2 on error (rio_prefetch_error).
+int64_t rio_prefetch_next(void* pp, const uint8_t** out) {
+  Prefetcher* p = (Prefetcher*)pp;
+  std::unique_lock<std::mutex> g(p->mu);
+  p->cv_pop.wait(g, [p] {
+    return !p->queue.empty() || p->active == 0 || !p->error.empty();
+  });
+  if (!p->error.empty() && p->queue.empty()) return -2;
+  if (p->queue.empty()) return -1;
+  p->current = std::move(p->queue.front());
+  p->queue.pop_front();
+  p->cv_push.notify_one();
+  *out = p->current.data();
+  return (int64_t)p->current.size();
+}
+
+const char* rio_prefetch_error(void* pp) {
+  // Snapshot under the lock into a consumer-owned buffer: workers may
+  // still be assigning `error` concurrently, and handing out its c_str()
+  // unlocked would race the reallocation. Single-consumer contract:
+  // only the popping thread calls this.
+  Prefetcher* p = (Prefetcher*)pp;
+  std::lock_guard<std::mutex> g(p->mu);
+  p->error_out = p->error;
+  return p->error_out.c_str();
+}
+
+void rio_prefetch_close(void* pp) {
+  Prefetcher* p = (Prefetcher*)pp;
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->closing = true;
+    p->cv_push.notify_all();
+  }
+  for (auto& t : p->threads) t.join();
+  delete p;
 }
 
 }  // extern "C"

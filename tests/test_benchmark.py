@@ -62,3 +62,16 @@ def test_peak_flops_lookup():
     # CPU -> unknown; a TPU device_kind would hit the table
     peak = device_peak_flops()
     assert peak is None or peak > 1e13
+
+
+def test_run_infer_resnet_smoke():
+    """Inference benchmark mode (reference IntelOptimizedPaddle.md infer
+    table surface): runs the eval forward and reports vs_baseline."""
+    import jax.numpy as jnp
+    from paddle_tpu.benchmark.models import run_infer
+    r = run_infer("resnet50", batch_size=1, dtype=jnp.float32,
+                  min_time=0.1)
+    assert r.value > 0
+    assert r.unit == "imgs/s"
+    assert r.vs_baseline is not None      # published bs=1 number exists
+    assert r.model == "resnet50_infer"

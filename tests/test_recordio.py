@@ -102,3 +102,56 @@ def test_reader_decorator_composes(tmp_path):
     batches = list(r())
     assert len(batches) == 4
     np.testing.assert_array_equal(np.asarray(batches[0]), [0, 1, 2, 3, 4])
+
+
+class TestPrefetch:
+    """Native multi-file prefetch reader (reference open_files_op +
+    buffered_reader async tier)."""
+
+    def _write_files(self, tmp_path, n_files=3, per_file=50):
+        from paddle_tpu.recordio import write_recordio
+        paths, want = [], set()
+        for i in range(n_files):
+            p = str(tmp_path / f"f{i}.rio")
+            recs = [f"file{i}-rec{j}".encode() for j in range(per_file)]
+            write_recordio(p, recs)
+            paths.append(p)
+            want.update(recs)
+        return paths, want
+
+    def test_reads_all_records_across_files(self, tmp_path):
+        from paddle_tpu.recordio import PrefetchScanner, native_available
+        paths, want = self._write_files(tmp_path)
+        with PrefetchScanner(paths, n_threads=3, queue_capacity=8) as sc:
+            got = list(sc)
+        assert set(got) == want
+        assert len(got) == len(want)
+
+    def test_prefetch_reader_decorator(self, tmp_path):
+        from paddle_tpu.recordio import prefetch_reader
+        paths, want = self._write_files(tmp_path, n_files=2, per_file=10)
+        got = list(prefetch_reader(paths)())
+        assert set(got) == want
+
+    def test_python_fallback(self, tmp_path):
+        from paddle_tpu.recordio import PrefetchScanner
+        paths, want = self._write_files(tmp_path, n_files=2, per_file=5)
+        sc = PrefetchScanner(paths, force_python=True)
+        assert set(sc) == want
+
+    def test_backpressure_small_queue(self, tmp_path):
+        from paddle_tpu.recordio import PrefetchScanner
+        paths, want = self._write_files(tmp_path, n_files=2, per_file=200)
+        with PrefetchScanner(paths, n_threads=2, queue_capacity=2) as sc:
+            got = list(sc)
+        assert set(got) == want
+
+    def test_early_close_joins_workers(self, tmp_path):
+        from paddle_tpu.recordio import PrefetchScanner, native_available
+        if not native_available():
+            return
+        paths, _ = self._write_files(tmp_path, n_files=2, per_file=500)
+        sc = PrefetchScanner(paths, n_threads=2, queue_capacity=2)
+        it = iter(sc)
+        next(it)                        # consume one, workers blocked
+        sc.close()                      # must not deadlock
