@@ -51,6 +51,9 @@ def main(argv=None) -> int:
 
     from paddle_tpu.benchmark.models import MODELS, run_model
 
+    if args.infer and args.scaling:
+        p.error("--infer and --scaling are mutually exclusive")
+
     if args.scaling:
         from paddle_tpu.benchmark.scaling import run_scaling
         sizes = [int(s) for s in args.scaling.split(",")]

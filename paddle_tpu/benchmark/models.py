@@ -222,7 +222,9 @@ def _infer_models():
     }
 
 
-INFER_MODELS = ("alexnet", "googlenet", "resnet50", "vgg16")
+# derived from the ctor table so the CLI gate and run_infer can
+# never drift apart
+INFER_MODELS = tuple(sorted(_infer_models()))
 
 
 def run_infer(name: str, batch_size: int = 16, dtype=jnp.float32,

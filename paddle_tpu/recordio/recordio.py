@@ -320,16 +320,23 @@ class PrefetchScanner:
                 yield from Scanner(p, force_python=True)
             return
         out = ctypes.POINTER(ctypes.c_ubyte)()
-        while True:
-            n = self._lib.rio_prefetch_next(self._h, ctypes.byref(out))
-            if n == -1:
-                self.close()            # auto-close like Scanner: joins
-                return                  # workers, frees queued records
-            if n == -2:
-                msg = self._lib.rio_prefetch_error(self._h).decode()
-                self.close()            # unblocks + joins healthy workers
-                raise IOError(msg)
-            yield ctypes.string_at(out, n)
+        try:
+            while self._h:              # closed/exhausted -> stop cleanly
+                n = self._lib.rio_prefetch_next(self._h, ctypes.byref(out))
+                if n == -1:
+                    return
+                if n == -2:
+                    raise IOError(
+                        self._lib.rio_prefetch_error(self._h).decode())
+                yield ctypes.string_at(out, n)
+        finally:
+            # auto-close like Scanner — and on ANY exit (exhaustion,
+            # error, abandoned iteration/GeneratorExit) join the workers
+            # and free queued records
+            self.close()
+
+    def __del__(self):
+        self.close()
 
     def close(self):
         if self._lib is not None and self._h:

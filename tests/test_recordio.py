@@ -155,3 +155,13 @@ class TestPrefetch:
         it = iter(sc)
         next(it)                        # consume one, workers blocked
         sc.close()                      # must not deadlock
+
+    def test_abandoned_iteration_and_reiteration_safe(self, tmp_path):
+        from paddle_tpu.recordio import PrefetchScanner
+        paths, want = self._write_files(tmp_path, n_files=2, per_file=100)
+        sc = PrefetchScanner(paths, n_threads=2, queue_capacity=2)
+        for rec in sc:          # abandon mid-stream: finally must close
+            break
+        assert sc._h is None or sc._lib is None
+        # second iteration after close: empty, no crash
+        assert list(sc) == [] or sc._lib is None
