@@ -332,7 +332,8 @@ class BatchNorm(Module):
     def __init__(self, momentum: float = 0.9, epsilon: float = 1e-5,
                  scale: bool = True, center: bool = True, axis: int = -1,
                  dtype=None, param_dtype=jnp.float32,
-                 axis_name: Optional[str] = None):
+                 axis_name: Optional[str] = None,
+                 fuse_relu: bool = False):
         super().__init__()
         self.momentum = momentum
         self.epsilon = epsilon
@@ -344,6 +345,19 @@ class BatchNorm(Module):
         # If set, batch stats are psum-averaged over this mesh axis
         # (sync-BN — the multi-device analog of the reference's per-device BN).
         self.axis_name = axis_name
+        # fuse_relu folds the activation INTO the layer and uses the
+        # memory-efficient custom backward (nn/fused_bn.py): backward
+        # reconstructs normalized activations from the output, so the
+        # pre-BN tensor is never saved — the main HBM saver for conv+BN
+        # towers (PERF_NOTES.md roofline).
+        self.fuse_relu = fuse_relu
+
+    def _update_ema(self, cx: Context, mean_rv, var_rv, mean, var) -> None:
+        m = self.momentum
+        cx.set_state("mean", (m * mean_rv + (1 - m) * mean)
+                     .astype(self.param_dtype))
+        cx.set_state("var", (m * var_rv + (1 - m) * var)
+                     .astype(self.param_dtype))
 
     def forward(self, cx: Context, x, use_running_stats: Optional[bool] = None):
         feat = x.shape[self.axis]
@@ -357,6 +371,17 @@ class BatchNorm(Module):
 
         use_running = (not cx.training) if use_running_stats is None \
             else use_running_stats
+        if (self.fuse_relu and not use_running and self.scale
+                and self.center and self.axis in (-1, x.ndim - 1)
+                and self.axis_name is None):
+            from paddle_tpu.nn.fused_bn import bn_relu_train
+            g = cx.param("scale", (feat,), I.ones, self.param_dtype)
+            b = cx.param("bias", (feat,), I.zeros, self.param_dtype)
+            y, mean, var = bn_relu_train(x, g.astype(jnp.float32),
+                                         b.astype(jnp.float32),
+                                         float(self.epsilon))
+            self._update_ema(cx, mean_rv, var_rv, mean, var)
+            return y.astype(self.dtype or x.dtype)
         if use_running:
             mean, var = mean_rv, var_rv
         else:
@@ -367,11 +392,7 @@ class BatchNorm(Module):
                 mean = lax.pmean(mean, self.axis_name)
                 mean2 = lax.pmean(mean2, self.axis_name)
             var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
-            m = self.momentum
-            cx.set_state("mean", (m * mean_rv + (1 - m) * mean)
-                         .astype(self.param_dtype))
-            cx.set_state("var", (m * var_rv + (1 - m) * var)
-                         .astype(self.param_dtype))
+            self._update_ema(cx, mean_rv, var_rv, mean, var)
 
         inv = lax.rsqrt(var.astype(jnp.float32) + self.epsilon)
         y = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
@@ -381,6 +402,10 @@ class BatchNorm(Module):
         if self.center:
             b = cx.param("bias", (feat,), I.zeros, self.param_dtype)
             y = y + b.reshape(shape)
+        if self.fuse_relu:
+            # the layer owns its activation in fused mode; this branch is
+            # the eval / non-fusable fallback with identical semantics
+            y = jax.nn.relu(y)
         # dtype=None: match the input dtype (stats stay fp32 above). A bf16
         # activation stream stays bf16 end to end — upcasting here doubles
         # HBM traffic on every norm, the main MFU sink found in round 2.
