@@ -5,3 +5,6 @@ from paddle_tpu.data.readers import (
 )
 from paddle_tpu.data.bucketing import bucket_boundaries, bucket_by_length
 from paddle_tpu.data.feeder import DataFeeder, device_prefetch
+from paddle_tpu.data.datafeed import (
+    MultiSlotDataFeed, SlotSpec, to_padded, write_slot_file,
+)

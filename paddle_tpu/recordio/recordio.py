@@ -13,43 +13,15 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
-import tempfile
-import threading
 import zlib
 from typing import Iterable, Iterator, Optional
+
+from paddle_tpu.utils.native import LazyLib as NativeLazyLib
 
 _MAGIC = 0x50545231
 _HEAD = struct.Struct("<6I")   # magic, compressor, nrec, raw, payload, crc
 
-_lib = None
-_lib_lock = threading.Lock()
-_lib_tried = False
-
-
-def _build_native() -> Optional[ctypes.CDLL]:
-    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "recordio.cc")
-    if not os.path.exists(src):
-        return None
-    cache = os.environ.get("PTPU_CACHE_DIR") or os.path.join(
-        tempfile.gettempdir(), f"paddle_tpu_native_{os.getuid()}")
-    os.makedirs(cache, exist_ok=True)
-    lib_path = os.path.join(cache, "librecordio.so")
-    if (not os.path.exists(lib_path)
-            or os.path.getmtime(lib_path) < os.path.getmtime(src)):
-        tmp = lib_path + f".tmp{os.getpid()}"
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-               "-lz", "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, lib_path)
-        except (OSError, subprocess.SubprocessError):
-            return None
-    try:
-        lib = ctypes.CDLL(lib_path)
-    except OSError:
-        return None
+def _bind(lib: ctypes.CDLL) -> None:
     lib.rio_writer_open.restype = ctypes.c_void_p
     lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                     ctypes.c_uint32]
@@ -80,16 +52,15 @@ def _build_native() -> Optional[ctypes.CDLL]:
     lib.rio_prefetch_error.argtypes = [ctypes.c_void_p]
     lib.rio_prefetch_close.restype = None
     lib.rio_prefetch_close.argtypes = [ctypes.c_void_p]
-    return lib
+
+
+_lazy = NativeLazyLib(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "recordio.cc"),
+    "librecordio.so", _bind, extra_flags=("-lz",))
 
 
 def _native() -> Optional[ctypes.CDLL]:
-    global _lib, _lib_tried
-    with _lib_lock:
-        if not _lib_tried:
-            _lib = _build_native()
-            _lib_tried = True
-        return _lib
+    return _lazy.get()
 
 
 def native_available() -> bool:

@@ -15,21 +15,15 @@ import ctypes
 import os
 import subprocess
 import sysconfig
-import tempfile
 from typing import List, Optional, Sequence
 
 import numpy as np
 
+from paddle_tpu.utils.native import cache_dir as _cache_dir
+
 _DTYPES = ["float32", "float64", "int32", "int64", "uint8", "int8",
            "bool", "bfloat16", "float16"]
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-
-
-def _cache_dir() -> str:
-    d = os.environ.get("PTPU_CACHE_DIR") or os.path.join(
-        tempfile.gettempdir(), f"paddle_tpu_native_{os.getuid()}")
-    os.makedirs(d, exist_ok=True)
-    return d
 
 
 def _py_flags() -> List[str]:

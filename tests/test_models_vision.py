@@ -39,6 +39,22 @@ def test_resnet_tiny():
     assert "state" in variables and variables["state"]
 
 
+def test_resnet_s2d_stem():
+    """Space-to-depth stem: same output resolution/classes as the 7x7/s2
+    stem, 8x8 effective receptive field (covers the 7x7)."""
+    model = ResNet(layers=(1, 1, 1, 1), num_classes=7, s2d_stem=True)
+    variables, out = _run(model, (2, 64, 64, 3), training=True)
+    assert out.shape == (2, 7)
+    ref = ResNet(layers=(1, 1, 1, 1), num_classes=7)
+    ref_vars, ref_out = _run(ref, (2, 64, 64, 3), training=True)
+    assert ref_out.shape == out.shape
+    # stem kernel is 4x4 over 4*C channels instead of 7x7 over C
+    stem_w = jax.tree.leaves(
+        {k: v for k, v in variables["params"].items() if "stem" in k})
+    assert any(w.shape[:2] == (4, 4) and w.shape[2] == 12
+               for w in stem_w if w.ndim == 4)
+
+
 def test_vgg_tiny():
     _, out = _run(VGG(depth=11, num_classes=5), (1, 32, 32, 3))
     assert out.shape == (1, 5)
