@@ -42,9 +42,14 @@ def _scaling_subprocess():
         "import json\n"
         "from paddle_tpu.benchmark.scaling import run_scaling, "
         "scaling_summary\n"
+        "out = {}\n"
         "rows = run_scaling('mlp', sizes=(1, 2, 4, 8), per_chip_batch=64,"
         " min_time=0.3)\n"
-        "print('SCALING ' + json.dumps(scaling_summary(rows)))\n")
+        "out.update(scaling_summary(rows))\n"
+        "rows = run_scaling('bert_tiny', sizes=(1, 2, 4, 8),"
+        " per_chip_batch=8, min_time=0.3)\n"
+        "out.update(scaling_summary(rows, prefix='bert_'))\n"
+        "print('SCALING ' + json.dumps(out))\n")
     proc = subprocess.run([sys.executable, "-c", code], cwd=here, env=env,
                           capture_output=True, text=True, timeout=900)
     for line in proc.stdout.splitlines():
@@ -86,6 +91,36 @@ def _longcontext_bench(seq: int = 16384):
     out["attn16k_flash_speedup"] = round(
         out["attn16k_dense_ms"] / out["attn16k_flash_ms"], 2)
     return out
+
+
+def _resnet_s2d(min_time: float, bs: int = 128):
+    """ResNet-50 with the space-to-depth stem (equivalent-capacity
+    reparameterization; PERF_NOTES.md addendum)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.benchmark.harness import bench_trainer
+    from paddle_tpu.core.executor import Trainer, supervised_loss
+    from paddle_tpu.metrics import accuracy
+    from paddle_tpu.models import vision as V
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Momentum
+
+    model = V.ResNet((3, 4, 6, 3), 1000, dtype=jnp.bfloat16, s2d_stem=True)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(
+            lg.astype(jnp.float32), y),
+        metrics={"acc": accuracy})
+    trainer = Trainer(model, Momentum(0.1, momentum=0.9), loss_fn)
+    rs = np.random.RandomState(0)
+    x = rs.randn(bs, 224, 224, 3).astype(np.float32)
+    y = rs.randint(0, 1000, bs).astype(np.int64)
+    ts = trainer.init_state(jnp.zeros((bs, 224, 224, 3)))
+    batch = jax.device_put((x, y))
+    return bench_trainer("resnet50_s2d", trainer, ts, batch,
+                         items_per_step=bs, unit="imgs/s", batch_size=bs,
+                         min_time=min_time)
 
 
 def _retry(fn, attempts: int = 2):
@@ -135,6 +170,15 @@ def main():
         except Exception as e:
             extra["resnet50_best_bs_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    if on_tpu:  # space-to-depth stem variant (PERF_NOTES: +1% measured)
+        try:
+            s2d = _retry(lambda: _resnet_s2d(min_time=min_time))
+            extra["resnet50_s2d_imgs_per_sec_bs128"] = round(s2d.value, 1)
+            extra["resnet50_s2d_mfu"] = (round(s2d.mfu, 4)
+                                         if s2d.mfu else None)
+        except Exception as e:
+            extra["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:160]
+
     try:
         xf = _retry(lambda: run_model(
             "transformer", batch_size=64 if on_tpu else 2,
@@ -148,6 +192,15 @@ def main():
     except Exception as e:  # primary metric must still print
         extra["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    if on_tpu:  # BERT-base MLM pretraining step (BASELINE BERT row)
+        try:
+            b = _retry(lambda: run_model("bert", batch_size=64,
+                                         dtype=dtype, min_time=min_time))
+            extra["bert_tokens_per_sec"] = round(b.value, 1)
+            extra["bert_mfu"] = round(b.mfu, 4) if b.mfu else None
+        except Exception as e:
+            extra["bert_error"] = f"{type(e).__name__}: {e}"[:160]
+
     if on_tpu:  # inference throughput (reference publishes infer tables)
         try:
             from paddle_tpu.benchmark.models import run_infer
@@ -159,6 +212,19 @@ def main():
                 round(inf.vs_baseline, 1) if inf.vs_baseline else None)
         except Exception as e:
             extra["infer_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if on_tpu:  # reference GPU-table headline models (K40m ms/batch,
+        # BASELINE.md: AlexNet 334 ms, GoogLeNet 1149 ms at bs=128)
+        for name, ref_ms in (("alexnet", 334.0), ("googlenet", 1149.0)):
+            try:
+                r = _retry(lambda: run_model(name, batch_size=128,
+                                             dtype=dtype,
+                                             min_time=min_time))
+                extra[f"{name}_train_ms_bs128"] = round(r.ms_per_step, 2)
+                extra[f"{name}_vs_k40m_speedup"] = round(
+                    ref_ms / r.ms_per_step, 1)
+            except Exception as e:
+                extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:160]
 
     if on_tpu:  # flash kernel on-hardware correctness gate
         try:

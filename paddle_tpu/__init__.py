@@ -32,6 +32,7 @@ from paddle_tpu.core.module import (
 )
 from paddle_tpu.core.executor import (
     Executor, NaiveExecutor, Trainer, TrainState, supervised_loss,
+    train_from_files,
 )
 from paddle_tpu import nn, ops, optim
 

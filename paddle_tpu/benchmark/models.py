@@ -139,6 +139,51 @@ def _stacked_lstm(name, batch_size, dtype, mesh, strategy, rules, min_time,
                          baseline=BASELINES.get(name), baseline_is_ms=True)
 
 
+def _bert(name, batch_size, dtype, mesh, strategy, rules, min_time,
+          seq_len: int = 128, vocab: int = 30522, model_dim: int = 768,
+          num_layers: int = 12, num_heads: int = 12, ffn_dim: int = 3072,
+          mask_frac: float = 0.15):
+    """BERT-base MLM pretraining step (BASELINE BERT row: pod-scale
+    allreduce / 8->32 chip scaling). Static masked-position count keeps
+    the step one compile."""
+    from paddle_tpu.models.transformer import BertEncoder
+    bs = batch_size or 32
+    k = max(1, int(seq_len * mask_frac))
+    model = BertEncoder(vocab=vocab, model_dim=model_dim,
+                        num_heads=num_heads, num_layers=num_layers,
+                        ffn_dim=ffn_dim, max_len=seq_len, dropout=0.0,
+                        dtype=dtype)
+
+    def loss_fn(module, variables, batch, rng, training):
+        tokens, positions, labels = batch
+        logits, mut = module.apply(variables, tokens, positions,
+                                   training=training, rngs=rng,
+                                   mutable=True)
+        loss = jnp.mean(F.softmax_with_cross_entropy(
+            logits.astype(jnp.float32), labels))
+        return (loss, {}), mut.get("state", {})
+
+    trainer = _trainer_for(model, loss_fn, Adam(1e-4), mesh, strategy,
+                           rules)
+    rs = np.random.RandomState(0)
+    tokens = rs.randint(0, vocab, (bs, seq_len)).astype(np.int32)
+    positions = np.sort(
+        rs.rand(bs, seq_len).argsort(axis=1)[:, :k], axis=1).astype(np.int32)
+    labels = rs.randint(0, vocab, (bs, k)).astype(np.int32)
+    ts = trainer.init_state(jnp.asarray(tokens), jnp.asarray(positions))
+    batch = _put(trainer, (tokens, positions, labels))
+    return bench_trainer(name, trainer, ts, batch,
+                         items_per_step=bs * seq_len, unit="tokens/s",
+                         batch_size=bs, min_time=min_time)
+
+
+def _bert_tiny(name, batch_size, dtype, mesh, strategy, rules, min_time):
+    """Small-config BERT for CPU-mesh scaling CI (same code path)."""
+    return _bert(name, batch_size, dtype, mesh, strategy, rules, min_time,
+                 seq_len=32, vocab=1024, model_dim=64, num_layers=2,
+                 num_heads=4, ffn_dim=128)
+
+
 def _deepfm(name, batch_size, dtype, mesh, strategy, rules, min_time,
             fields: int = 26, vocab_per_field: int = 1000, dense_dim: int = 13):
     """DeepFM CTR (dist_ctr capability; BASELINE DeepFM target)."""
@@ -184,6 +229,8 @@ def _registry() -> Dict[str, Callable]:
         "googlenet": _image_spec(
             lambda num_classes, dtype: V.GoogLeNet(num_classes, dtype=dtype)),
         "transformer": _transformer,
+        "bert": _bert,
+        "bert_tiny": _bert_tiny,
         "stacked_lstm": _stacked_lstm,
         "deepfm": _deepfm,
     }

@@ -59,13 +59,23 @@ def run_scaling(model: str = "mlp", sizes: Sequence[int] = (1, 2, 4, 8),
     return results
 
 
-def scaling_summary(results: List[Dict[str, Any]]) -> Dict[str, Any]:
+def scaling_summary(results: List[Dict[str, Any]],
+                    prefix: str = "") -> Dict[str, Any]:
     """Compact form for bench.py extra: largest-mesh efficiency, labeled
     with the platform it ran on (cpu-mesh numbers are plumbing checks,
-    not hardware scaling claims)."""
+    not hardware scaling claims).
+
+    On a cpu mesh the N virtual devices SHARE the host cores, so ideal
+    weak-scaling per-chip efficiency is 1/dp, not 1 — `vs_shared_core_
+    ideal` = efficiency*dp normalizes that out (≈1.0 means the sharded
+    step and its collectives add no overhead beyond the shared silicon)."""
     ran = [r for r in results if "efficiency" in r]
     if not ran:
         return {}
     last = ran[-1]
-    return {f"dp{last['dp']}_scaling_eff": last["efficiency"],
-            "scaling_platform": last["platform"]}
+    out = {f"{prefix}dp{last['dp']}_scaling_eff": last["efficiency"],
+           "scaling_platform": last["platform"]}
+    if last["platform"] == "cpu":
+        out[f"{prefix}dp{last['dp']}_vs_shared_core_ideal"] = round(
+            last["efficiency"] * last["dp"], 3)
+    return out

@@ -261,6 +261,67 @@ def supervised_loss(criterion: Callable[[jax.Array, jax.Array], jax.Array],
     return loss_fn
 
 
+def train_from_files(trainer: "Trainer", ts: TrainState,
+                     files: Sequence[str], slots,
+                     batch_fn: Optional[Callable] = None, *,
+                     batch_size: int = 128, nthreads: int = 2,
+                     epochs: int = 1, prefetch: int = 2,
+                     max_sparse_len: Optional[int] = None,
+                     drop_last: bool = True,
+                     callback: Optional[Callable[[int, Dict], None]] = None
+                     ) -> TrainState:
+    """Train straight from slot-format text files.
+
+    The AsyncExecutor.RunFromFile capability (reference
+    framework/async_executor.cc:236: training threads consume a DataFeed
+    without returning to Python between examples) in TPU form: the native
+    MultiSlotDataFeed parses files on C++ threads, sparse slots convert to
+    static-shape padded+mask form, and `data.feeder.device_prefetch` keeps
+    `prefetch` H2D transfers in flight so parsing and copies overlap the
+    device step.
+
+    `batch_fn(batch_dict) -> model batch` adapts a columnar batch (dense
+    slots: arrays; sparse slots: (padded, mask) after conversion) to the
+    trainer's batch convention; default passes the dict through. With
+    `drop_last` the ragged tail batch is dropped so every step reuses one
+    compiled shape (a tail batch would recompile and, at scale, that is
+    almost always the wrong trade).
+    """
+    from paddle_tpu.data.datafeed import MultiSlotDataFeed, to_padded
+    from paddle_tpu.data.feeder import device_prefetch
+
+    feed = MultiSlotDataFeed(files, slots, batch_size=batch_size,
+                             nthreads=nthreads)
+    sparse = [s.name for s in feed.slots if not s.dense]
+    if sparse and max_sparse_len is None:
+        raise ValueError(
+            f"sparse slots {sparse} need max_sparse_len for the "
+            "static-shape padded form")
+
+    def batches():
+        for b in feed:
+            rows = next(iter(b.values()))
+            n = rows.shape[0] if not isinstance(rows, tuple) \
+                else len(rows[1]) - 1
+            if drop_last and n != batch_size:
+                continue
+            out = {}
+            for name, v in b.items():
+                out[name] = (to_padded(v[0], v[1], max_sparse_len)
+                             if isinstance(v, tuple) else v)
+            yield batch_fn(out) if batch_fn is not None else out
+
+    s = host_step_of(ts)
+    _stamp_step(ts, s)
+    for _ in range(epochs):
+        for batch in device_prefetch(batches(), size=prefetch):
+            ts, fetches = trainer.train_step(ts, batch)
+            s += 1
+            if callback is not None:
+                callback(s, fetches)
+    return ts
+
+
 # --------------------------------------------------------------------------
 # Executor: generic compiled-program runner with feed/fetch (reference API).
 # --------------------------------------------------------------------------

@@ -117,7 +117,9 @@ class MultiSlotDataFeed:
     """Iterate slot-format text files as columnar batches.
 
     `native=None` auto-selects the C++ parser when it builds, else the
-    Python fallback; both produce identical batches for the same input.
+    Python fallback. Both yield the same rows in same-size batches (all
+    full batches plus at most one tail); with nthreads > 1 the native
+    path's batch composition/order is nondeterministic across files.
     """
 
     def __init__(self, files: Sequence[str],
@@ -144,6 +146,11 @@ class MultiSlotDataFeed:
 
     # ------------------------------------------------------------- native
     def _iter_native(self) -> Iterator[Batch]:
+        """Full batches stream straight through; each worker's end-of-file
+        partial batch is held back and merged with the others so at most
+        ONE tail batch (< batch_size rows) is emitted — same row set and
+        batch size as the Python path (batch composition may differ with
+        nthreads > 1 since file order is nondeterministic)."""
         lib = self._lib
         arr = (ctypes.c_char_p * len(self.files))(
             *[f.encode() for f in self.files])
@@ -152,6 +159,7 @@ class MultiSlotDataFeed:
                         self.queue_cap)
         if not h:
             raise RuntimeError("df_open failed (bad config or files)")
+        partials: List[Batch] = []
         try:
             while True:
                 b = lib.df_next(h)
@@ -160,11 +168,19 @@ class MultiSlotDataFeed:
                     if err:
                         raise RuntimeError(
                             f"datafeed: {err.decode(errors='replace')}")
-                    return
+                    break
                 try:
-                    yield self._convert_native(lib, h, b)
+                    batch = self._convert_native(lib, h, b)
+                    rows = lib.df_batch_rows(b)
                 finally:
                     lib.df_batch_free(b)
+                if rows == self.batch_size:
+                    yield batch
+                else:
+                    partials.append(batch)
+            if partials:
+                merged = _merge_batches(partials, self.slots)
+                yield from _split_batch(merged, self.slots, self.batch_size)
         finally:
             lib.df_close(h)
 
@@ -246,6 +262,46 @@ class MultiSlotDataFeed:
         return out
 
 
+def _batch_rows(batch: Batch) -> int:
+    v = next(iter(batch.values()))
+    return len(v[1]) - 1 if isinstance(v, tuple) else v.shape[0]
+
+
+def _merge_batches(batches: Sequence[Batch], slots) -> Batch:
+    """Concatenate columnar batches rowwise (CSR offsets rebased)."""
+    out: Batch = {}
+    for s in slots:
+        parts = [b[s.name] for b in batches]
+        if s.dense:
+            out[s.name] = np.concatenate(parts, axis=0)
+        else:
+            vals = np.concatenate([p[0] for p in parts])
+            offs = [np.zeros(1, np.int64)]
+            base = 0
+            for p in parts:
+                offs.append(p[1][1:] + base)
+                base += p[1][-1]
+            out[s.name] = (vals, np.concatenate(offs))
+    return out
+
+
+def _split_batch(batch: Batch, slots, batch_size: int) -> Iterator[Batch]:
+    """Re-chunk a merged batch into batch_size pieces + one tail."""
+    rows = _batch_rows(batch)
+    for lo in range(0, rows, batch_size):
+        hi = min(lo + batch_size, rows)
+        piece: Batch = {}
+        for s in slots:
+            v = batch[s.name]
+            if s.dense:
+                piece[s.name] = v[lo:hi]
+            else:
+                vals, offs = v
+                piece[s.name] = (vals[offs[lo]:offs[hi]],
+                                 offs[lo:hi + 1] - offs[lo])
+        yield piece
+
+
 def write_slot_file(path: str, examples: Sequence[Sequence[Sequence]],
                     slots: Union[str, Sequence[SlotSpec]]) -> None:
     """Write examples (per example: one value-list per slot) as slot text."""
@@ -268,13 +324,14 @@ def write_slot_file(path: str, examples: Sequence[Sequence[Sequence]],
 def to_padded(values: np.ndarray, offsets: np.ndarray, max_len: int,
               pad=0) -> Tuple[np.ndarray, np.ndarray]:
     """CSR -> (padded [rows, max_len], mask [rows, max_len]) — the static-
-    shape form TPU models take (replaces LoD; over-length rows truncate)."""
+    shape form TPU models take (replaces LoD; over-length rows truncate).
+    Vectorized: this sits on the training hot path (train_from_files)."""
     rows = len(offsets) - 1
-    padded = np.full((rows, max_len), pad, values.dtype)
-    mask = np.zeros((rows, max_len), np.bool_)
-    for r in range(rows):
-        lo, hi = int(offsets[r]), int(offsets[r + 1])
-        n = min(hi - lo, max_len)
-        padded[r, :n] = values[lo:lo + n]
-        mask[r, :n] = True
-    return padded, mask
+    lens = np.minimum(np.diff(offsets), max_len)
+    pos = np.arange(max_len)
+    mask = pos[None, :] < lens[:, None]
+    if len(values) == 0:
+        return np.full((rows, max_len), pad, values.dtype), mask
+    idx = np.minimum(offsets[:-1, None] + pos[None, :], len(values) - 1)
+    padded = np.where(mask, values[idx], np.asarray(pad, values.dtype))
+    return padded.astype(values.dtype), mask

@@ -237,3 +237,52 @@ class Transformer(Module):
             new_caches.append(nc)
         logits = self.head(cx, self.dec_ln(cx, x))
         return logits[:, 0], new_caches
+
+
+class BertEncoder(Module):
+    """BERT-style encoder for masked-LM pretraining.
+
+    The BASELINE.md BERT-base row ("pod-scale ICI allreduce, 8->32 chip
+    scaling efficiency") — the reference itself has no BERT, so this is
+    the pretraining proxy built from the same EncoderLayer stack the
+    Transformer uses (q/k/v/out + fc1/fc2 names keep the tp rule table
+    applicable; pre-LN layers, so LR-warmup dynamics differ from the
+    original post-LN BERT). Learned position embeddings, MLM head tied
+    to the token table via Embedding.attend.
+    """
+
+    def __init__(self, vocab: int = 30522, model_dim: int = 768,
+                 num_heads: int = 12, num_layers: int = 12,
+                 ffn_dim: int = 3072, max_len: int = 512,
+                 dropout: float = 0.1, dtype=jnp.float32):
+        super().__init__()
+        self.model_dim = model_dim
+        self.dtype = dtype
+        self.embed = Embedding(vocab, model_dim, dtype=dtype)
+        self.pos_embed = Embedding(max_len, model_dim, dtype=dtype)
+        self.layers = [EncoderLayer(model_dim, num_heads, ffn_dim,
+                                    dropout, dtype)
+                       for _ in range(num_layers)]
+        self.ln = LayerNorm()
+        self.drop = Dropout(dropout)
+
+    def forward(self, cx: Context, tokens, mask_positions=None,
+                lengths=None):
+        """Hidden states [B, T, D]; with `mask_positions` [B, K], tied-head
+        MLM vocab logits [B, K, V] at those positions instead (static K
+        keeps the pretraining step one compile)."""
+        t = tokens.shape[1]
+        x = self.embed(cx, tokens) + self.pos_embed(
+            cx, jnp.arange(t, dtype=jnp.int32))[None]
+        x = self.drop(cx, x)
+        mask = None
+        if lengths is not None:
+            mask = sequence_mask(lengths, t)[:, None, None, :]
+        for layer in self.layers:
+            x = layer(cx, x, mask=mask)
+        hidden = self.ln(cx, x)
+        if mask_positions is None:
+            return hidden
+        picked = jnp.take_along_axis(
+            hidden, mask_positions[..., None].astype(jnp.int32), axis=1)
+        return self.embed.attend(cx, picked)

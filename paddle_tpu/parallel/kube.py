@@ -48,7 +48,7 @@ def _check_name(name: str) -> str:
     return name
 
 
-def gen_service(name: str) -> Dict[str, Any]:
+def gen_service(name: str, coordinator_port: int = 8476) -> Dict[str, Any]:
     """Headless Service so pods resolve each other (and rank 0) by DNS."""
     _check_name(name)
     return {
@@ -58,7 +58,7 @@ def gen_service(name: str) -> Dict[str, Any]:
         "spec": {
             "clusterIP": "None",                 # headless: DNS only
             "selector": {"ptpu-job": name},
-            "ports": [{"name": "coordinator", "port": 8476}],
+            "ports": [{"name": "coordinator", "port": coordinator_port}],
         },
     }
 
@@ -85,6 +85,12 @@ def gen_job(name: str,
     _check_name(name)
     if num_hosts < 1:
         raise ValueError("num_hosts must be >= 1")
+    # pod hostnames are "{name}-{index}" and must also be DNS-1123 labels
+    longest = f"{name}-{num_hosts - 1}"
+    if len(longest) > _DNS1123_MAX:
+        raise ValueError(
+            f"job name {name!r} too long: pod hostname {longest!r} "
+            f"exceeds {_DNS1123_MAX} chars")
     if not command:
         raise ValueError("command must be non-empty")
 
@@ -153,7 +159,7 @@ def gen_job(name: str,
 def gen_manifests(name: str, image: str, command: Sequence[str],
                   num_hosts: int = 1, **kw) -> List[Dict[str, Any]]:
     """Service + Job, ready to serialize into one multi-doc YAML."""
-    return [gen_service(name),
+    return [gen_service(name, kw.get("coordinator_port", 8476)),
             gen_job(name, image, command, num_hosts=num_hosts, **kw)]
 
 

@@ -75,3 +75,19 @@ def test_run_infer_resnet_smoke():
     assert r.unit == "imgs/s"
     assert r.vs_baseline is not None      # published bs=1 number exists
     assert r.model == "resnet50_infer"
+
+
+def test_bert_bench_and_scaling():
+    """BERT MLM spec (BASELINE BERT row) runs, and the scaling sweep
+    reports per-chip efficiency with the shared-core normalization."""
+    import jax.numpy as jnp
+    from paddle_tpu.benchmark.scaling import run_scaling, scaling_summary
+    r = run_model("bert_tiny", batch_size=4, dtype=jnp.float32,
+                  min_time=0.05)
+    assert r.unit == "tokens/s" and r.value > 0
+    rows = run_scaling("bert_tiny", sizes=(1, 2), per_chip_batch=4,
+                       min_time=0.05)
+    s = scaling_summary(rows, prefix="bert_")
+    assert "bert_dp2_scaling_eff" in s
+    assert s["scaling_platform"] == "cpu"
+    assert "bert_dp2_vs_shared_core_ideal" in s

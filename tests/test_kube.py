@@ -45,6 +45,19 @@ def test_name_validation():
         kube.gen_job("x" * 64, "img", ["cmd"])
     with pytest.raises(ValueError):
         kube.gen_job("ok", "img", [])
+    # pod hostname "{name}-{index}" must itself fit the DNS label limit
+    with pytest.raises(ValueError, match="hostname"):
+        kube.gen_job("x" * 62, "img", ["cmd"], num_hosts=2)
+    kube.gen_job("x" * 61, "img", ["cmd"], num_hosts=2)  # 61+2 = 63 ok
+
+
+def test_coordinator_port_consistent():
+    svc, job = kube.gen_manifests("j", "img", ["c"], num_hosts=2,
+                                  coordinator_port=9999)
+    assert svc["spec"]["ports"][0]["port"] == 9999
+    env = {e["name"]: e for e in
+           job["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["PTPU_COORDINATOR"]["value"].endswith(":9999")
 
 
 def test_yaml_roundtrip():

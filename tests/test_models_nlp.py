@@ -102,3 +102,37 @@ def test_recommender_shapes(rng):
     score = model.apply(variables, u, i)
     assert score.shape == (8,)
     assert float(jnp.max(jnp.abs(score))) <= 5.0 + 1e-5
+
+
+def test_bert_encoder_mlm(rng):
+    """BertEncoder: hidden states, tied MLM head, grads flow, and the MLM
+    logits at a masked position depend on the other tokens (bidirectional
+    context, unlike the causal decoder)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.transformer import BertEncoder
+
+    m = BertEncoder(vocab=50, model_dim=32, num_heads=2, num_layers=2,
+                    ffn_dim=64, max_len=16, dropout=0.0)
+    toks = jnp.asarray(rng.randint(0, 50, (2, 8)))
+    pos = jnp.asarray(np.sort(rng.rand(2, 8).argsort(1)[:, :2], 1))
+    v = m.init(0, toks, pos)
+    hidden = m.apply(v, toks)
+    assert hidden.shape == (2, 8, 32)
+    logits = m.apply(v, toks, pos)
+    assert logits.shape == (2, 2, 50)
+    # tied head: vocab projection reuses the embedding table
+    flat = jax.tree_util.tree_leaves_with_path(v["params"])
+    names = ["/".join(str(k) for k in path) for path, _ in flat]
+    assert not any("head" in n for n in names)
+    # bidirectional: changing a NON-masked token moves the masked logits
+    toks2 = toks.at[0, 5].set((toks[0, 5] + 1) % 50)
+    assert pos[0, 0] != 5 and pos[0, 1] != 5
+    assert not np.allclose(np.asarray(m.apply(v, toks2, pos)[0]),
+                           np.asarray(logits[0]), atol=1e-6)
+    # grads flow to embeddings and attention
+    def loss(params):
+        out = m.apply({"params": params}, toks, pos)
+        return jnp.sum(out ** 2)
+    g = jax.grad(loss)(v["params"])
+    assert all(np.isfinite(x).all() for x in jax.tree.leaves(g))
