@@ -1,0 +1,55 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+Capability-equivalent of PaddlePaddle Fluid ~1.2 (the reference at
+/root/reference), redesigned TPU-first on JAX/XLA/Pallas/pjit:
+
+- `paddle_tpu.nn` / `paddle_tpu.ops` — layer + op library (≈ fluid.layers,
+  paddle/fluid/operators/)
+- `paddle_tpu.core` — module system, executor, program export (≈
+  framework.py Program/Block + framework/executor.cc)
+- `paddle_tpu.optim` — optimizers, LR schedules, clipping (≈ optimizer.py)
+- `paddle_tpu.parallel` — mesh/sharding engine: DP, ZeRO, tensor, sequence
+  (ring attention) parallelism over ICI/DCN collectives (≈ ParallelExecutor,
+  DistributeTranspiler, NCCL/gRPC stack)
+- `paddle_tpu.data` — reader decorators, datasets, device prefetch (≈
+  paddle.reader, operators/reader/)
+- `paddle_tpu.io` — checkpointing and inference export (≈ fluid.io)
+- `paddle_tpu.metrics` — metric ops (≈ fluid.metrics, operators/metrics/)
+- `paddle_tpu.kernels` — Pallas TPU kernels (≈ operators/jit, fused ops)
+- `paddle_tpu.profiler` — tracing/timeline (≈ platform/profiler)
+- `paddle_tpu.recordio` — chunked record file format, native C++ fast path
+  (≈ paddle/fluid/recordio)
+- `paddle_tpu.serving` — C++ serving shim over exported models (≈
+  inference/api/paddle_api.h)
+- `paddle_tpu.benchmark` — model-zoo benchmark harness with MFU (≈
+  benchmark/fluid/fluid_benchmark.py)
+- `paddle_tpu.testing` — numeric-gradient OpTest harness (≈ op_test.py)
+"""
+
+from paddle_tpu.utils.flags import FLAGS, get_flags, set_flags
+from paddle_tpu.core.module import (
+    Context, Module, Sequential, Variables, named_params, param_count,
+)
+from paddle_tpu.core.executor import (
+    Executor, NaiveExecutor, Trainer, TrainState, supervised_loss,
+    train_from_files,
+)
+from paddle_tpu import nn, ops, optim
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy subpackage access (data, io, metrics, models, parallel, ...) to
+    # keep base import light.
+    import importlib
+    if name in ("data", "io", "metrics", "models", "parallel", "kernels",
+                "profiler", "serving", "recordio", "benchmark", "testing",
+                "quant"):
+        try:
+            return importlib.import_module(f"paddle_tpu.{name}")
+        except ModuleNotFoundError as e:
+            # keep the hasattr/getattr contract: AttributeError, not MNFE
+            raise AttributeError(
+                f"module paddle_tpu has no attribute {name}") from e
+    raise AttributeError(f"module paddle_tpu has no attribute {name}")

@@ -1,0 +1,12 @@
+"""Benchmark harness + model zoo (fluid_benchmark.py capability).
+
+Reference: /root/reference/benchmark/fluid/fluid_benchmark.py:139 and
+benchmark/fluid/models/. Run `python -m paddle_tpu.benchmark --help`.
+"""
+
+from paddle_tpu.benchmark.harness import (
+    BenchResult, bench_trainer, compiled_flops, device_peak_flops, run_timed)
+from paddle_tpu.benchmark.models import MODELS, run_model
+
+__all__ = ["BenchResult", "bench_trainer", "compiled_flops",
+           "device_peak_flops", "run_timed", "MODELS", "run_model"]

@@ -1,0 +1,81 @@
+"""Scaling-efficiency benchmark: per-chip throughput across mesh sizes.
+
+The BASELINE.md BERT row asks for "8→32 chip scaling efficiency reported";
+the reference's multi-device benchmark is fluid_benchmark.py with
+--update_method nccl2 over N GPUs (/root/reference/benchmark/fluid/
+README.md). Here: run the same model at dp = 1, 2, 4, ... with a fixed
+per-chip batch (weak scaling), report per-chip items/s and efficiency
+vs dp=1.
+
+Runs unchanged on any device population — the 8-device virtual CPU mesh
+(plumbing/CI; numbers labeled cpu-mesh) or a real TPU slice.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def run_scaling(model: str = "mlp", sizes: Sequence[int] = (1, 2, 4, 8),
+                per_chip_batch: int = 32, dtype=jnp.float32,
+                min_time: float = 0.5) -> List[Dict[str, Any]]:
+    """Weak-scaling sweep: global batch = per_chip_batch * dp.
+
+    Returns one dict per mesh size: {dp, value, unit, per_chip,
+    efficiency, ms_per_step, device, platform}. efficiency =
+    per_chip(dp) / per_chip(1).
+    """
+    from paddle_tpu.benchmark.models import run_model
+    from paddle_tpu.parallel import DistStrategy, MeshConfig, make_mesh
+
+    devices = jax.devices()
+    results: List[Dict[str, Any]] = []
+    base_per_chip: Optional[float] = None
+    for dp in sizes:
+        if dp > len(devices):
+            results.append({"dp": dp, "skipped":
+                            f"only {len(devices)} devices"})
+            continue
+        mesh = make_mesh(MeshConfig(dp=dp), devices=devices[:dp])
+        r = run_model(model, batch_size=per_chip_batch * dp, dtype=dtype,
+                      mesh=mesh, strategy=DistStrategy(),
+                      min_time=min_time)
+        per_chip = r.value / dp
+        if base_per_chip is None:
+            base_per_chip = per_chip
+        results.append({
+            "dp": dp,
+            "value": round(r.value, 1),
+            "unit": r.unit,
+            "per_chip": round(per_chip, 1),
+            "efficiency": round(per_chip / base_per_chip, 4),
+            "ms_per_step": round(r.ms_per_step, 2),
+            "device": r.device,
+            "platform": devices[0].platform,
+        })
+    return results
+
+
+def scaling_summary(results: List[Dict[str, Any]],
+                    prefix: str = "") -> Dict[str, Any]:
+    """Compact form for bench.py extra: largest-mesh efficiency, labeled
+    with the platform it ran on (cpu-mesh numbers are plumbing checks,
+    not hardware scaling claims).
+
+    On a cpu mesh the N virtual devices SHARE the host cores, so ideal
+    weak-scaling per-chip efficiency is 1/dp, not 1 — `vs_shared_core_
+    ideal` = efficiency*dp normalizes that out (≈1.0 means the sharded
+    step and its collectives add no overhead beyond the shared silicon)."""
+    ran = [r for r in results if "efficiency" in r]
+    if not ran:
+        return {}
+    last = ran[-1]
+    out = {f"{prefix}dp{last['dp']}_scaling_eff": last["efficiency"],
+           "scaling_platform": last["platform"]}
+    if last["platform"] == "cpu":
+        out[f"{prefix}dp{last['dp']}_vs_shared_core_ideal"] = round(
+            last["efficiency"] * last["dp"], 3)
+    return out

@@ -1,0 +1,3 @@
+from paddle_tpu.core.module import (
+    Context, Module, Sequential, Variables, named_params, param_count,
+)

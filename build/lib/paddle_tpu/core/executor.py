@@ -287,8 +287,7 @@ def train_from_files(trainer: "Trainer", ts: TrainState,
     compiled shape (a tail batch would recompile and, at scale, that is
     almost always the wrong trade).
     """
-    from paddle_tpu.data.datafeed import (MultiSlotDataFeed, _batch_rows,
-                                          to_padded)
+    from paddle_tpu.data.datafeed import MultiSlotDataFeed, to_padded
     from paddle_tpu.data.feeder import device_prefetch
 
     feed = MultiSlotDataFeed(files, slots, batch_size=batch_size,
@@ -301,7 +300,10 @@ def train_from_files(trainer: "Trainer", ts: TrainState,
 
     def batches():
         for b in feed:
-            if drop_last and _batch_rows(b) != batch_size:
+            rows = next(iter(b.values()))
+            n = rows.shape[0] if not isinstance(rows, tuple) \
+                else len(rows[1]) - 1
+            if drop_last and n != batch_size:
                 continue
             out = {}
             for name, v in b.items():

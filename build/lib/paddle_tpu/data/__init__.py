@@ -1,0 +1,10 @@
+from paddle_tpu.data import bucketing, common, datasets, readers, transforms
+from paddle_tpu.data.readers import (
+    batch, buffered, cache, chain, compose, firstn, map_readers, shuffle,
+    xmap_readers,
+)
+from paddle_tpu.data.bucketing import bucket_boundaries, bucket_by_length
+from paddle_tpu.data.feeder import DataFeeder, device_prefetch
+from paddle_tpu.data.datafeed import (
+    MultiSlotDataFeed, SlotSpec, to_padded, write_slot_file,
+)

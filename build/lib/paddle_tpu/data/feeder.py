@@ -1,0 +1,71 @@
+"""Host→device feeding with double-buffered prefetch.
+
+Capability-equivalent of:
+- DataFeeder (python/paddle/fluid/data_feeder.py): batch→device-array
+  conversion + multi-device splitting.
+- BufferedReader's async H2D copies (operators/reader/buffered_reader.h:66):
+  here `device_prefetch` moves the NEXT batch to device (jax.device_put is
+  async) while the CURRENT step runs — the standard TPU input-overlap idiom.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def device_prefetch(it: Iterable, size: int = 2,
+                    sharding: Optional[Any] = None) -> Iterator:
+    """Yield device-resident batches, keeping `size` transfers in flight.
+
+    jax.device_put is asynchronous: enqueuing the copy for batch k+1 before
+    batch k's step completes overlaps H2D with compute (the reference gets
+    this from BufferedReader's dedicated CUDA stream).
+    """
+    put = (lambda x: jax.device_put(x, sharding)) if sharding is not None \
+        else jax.device_put
+    queue = []
+    it = iter(it)
+    try:
+        for _ in range(size):
+            queue.append(jax.tree.map(put, next(it)))
+    except StopIteration:
+        pass
+    for batch in it:
+        out = queue.pop(0)
+        queue.append(jax.tree.map(put, batch))
+        yield out
+    while queue:
+        yield queue.pop(0)
+
+
+class DataFeeder:
+    """Convert samples/batches to device arrays with dtype/shape conventions.
+
+    ≈ fluid.DataFeeder: the reference converts feed lists to LoDTensors per
+    place; here we convert to (optionally sharded) jax arrays. Ragged
+    sequence feeds use dense padding + explicit lengths (the TPU idiom
+    replacing LoD — see paddle_tpu.ops.sequence).
+    """
+
+    def __init__(self, feed_names: Sequence[str], dtypes=None,
+                 sharding: Optional[Any] = None):
+        self.feed_names = list(feed_names)
+        self.dtypes = dtypes or {}
+        self.sharding = sharding
+
+    def feed(self, batch) -> dict:
+        if isinstance(batch, dict):
+            items = [(k, batch[k]) for k in self.feed_names]
+        else:
+            items = list(zip(self.feed_names, batch))
+        out = {}
+        for name, value in items:
+            arr = np.asarray(value)
+            if name in self.dtypes:
+                arr = arr.astype(self.dtypes[name])
+            out[name] = (jax.device_put(arr, self.sharding)
+                         if self.sharding is not None else jax.device_put(arr))
+        return out

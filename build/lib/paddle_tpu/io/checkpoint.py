@@ -1,0 +1,472 @@
+"""Checkpoint save/load for arbitrary pytrees (TrainState, variables).
+
+Capability-equivalent of the reference persistence stack:
+- save/load_persistables (python/paddle/fluid/io.py:441,657) via save/load
+  graph ops (operators/save_op.cc, load_op.cc).
+- Distributed-aware save (_save_distributed_persistables io.py:261): the
+  reference gathers sliced param blocks from pservers; here every process
+  writes ONLY the shards it owns (addressable shards with replica_id 0),
+  so a multi-host FSDP/tp-sharded TrainState checkpoints without any
+  cross-host gather — the orbax-style sharded layout SURVEY §5.4 commits
+  to, in a dependency-free npz+json form.
+- On load, each process reads only the shard files that intersect the
+  pieces it needs (jax.make_array_from_callback drives which regions are
+  materialised) — the analog of slice-on-load
+  (_load_distributed_persistables io.py:704).
+- CheckpointManager adds retention + atomic-rename commit + resume.
+
+On-disk layout (format version 2):
+    manifest.json           tree structure: key, global shape, dtype per leaf
+    shards-p{K}.npz         arrays owned by process K
+    shard_index-p{K}.json   per-shard placement: leaf ordinal + index slices
+
+Multi-process coordination: processes meet at barriers between the write
+and commit phases. A process that fails locally drops an error marker
+next to the target path *before* entering the barrier, and every process
+checks for markers *after* it — so one bad disk surfaces as an exception
+everywhere instead of a silent hang. (A process that dies outright still
+hangs the collective — that is inherent to any barrier and is bounded by
+the job-level timeout, same as the reference's RPC deadline story.)
+
+No pickle anywhere — loadable by any numpy, auditable, language-neutral
+(the C++ serving shim reads the same manifest). Version-1 checkpoints
+(single arrays.npz) remain loadable.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+_MANIFEST = "manifest.json"
+_ARRAYS = "arrays.npz"  # version-1 layout (read-compat only)
+
+
+def _flatten(tree: Pytree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        out.append((key, leaf))
+    return out
+
+
+def _is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _barrier(name: str) -> None:
+    if _is_multiprocess():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def _index_to_json(index, shape) -> List[List[int]]:
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _normalize(region: Tuple[slice, ...], shape: Tuple[int, ...]
+               ) -> Tuple[Tuple[int, int], ...]:
+    """Slices (possibly open-ended) → concrete (start, stop) per dim."""
+    out = []
+    for sl, dim in zip(region, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+# -- failure-marker protocol around multi-process barriers ------------------
+
+def _marker(path: str, proc: int) -> str:
+    return os.path.abspath(path) + f".err-p{proc}"
+
+
+def _mark_failure(path: str, proc: int, exc: BaseException) -> None:
+    try:
+        with open(_marker(path, proc), "w") as f:
+            f.write(f"{type(exc).__name__}: {exc}")
+    except OSError:
+        pass  # the check below will still see *our* raised exception
+
+
+def _check_failures(path: str) -> None:
+    # glob.escape: a checkpoint path containing [ ] ? * must not be
+    # treated as a pattern, or peer-failure markers become invisible.
+    markers = sorted(glob.glob(glob.escape(os.path.abspath(path))
+                               + ".err-p*"))
+    if markers:
+        msgs = []
+        for m in markers:
+            try:
+                with open(m) as f:
+                    msgs.append(f"{os.path.basename(m)}: {f.read()}")
+            except OSError:
+                msgs.append(os.path.basename(m))
+        raise RuntimeError(
+            f"checkpoint save to {path} failed on a peer process:\n  "
+            + "\n  ".join(msgs))
+
+
+def _clear_markers(path: str) -> None:
+    for m in glob.glob(glob.escape(os.path.abspath(path)) + ".err-p*"):
+        try:
+            os.remove(m)
+        except OSError:
+            pass
+
+
+def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
+                    metadata: Optional[Dict] = None) -> str:
+    """Write `tree` to directory `path` atomically. Returns the path.
+
+    Every process participates: each writes the shards it owns (exactly
+    one process holds replica 0 of any shard index, so each piece of data
+    is written once globally). Process 0 additionally writes the manifest
+    and commits the rename. Assumes a shared filesystem across processes
+    (the same assumption the reference's pserver checkpointing makes).
+    """
+    flat = _flatten(tree)
+    proc = jax.process_index()
+    leaves_meta = []
+    my_shards: Dict[str, np.ndarray] = {}
+    my_index: List[dict] = []
+    for i, (key, leaf) in enumerate(flat):
+        if isinstance(leaf, jax.Array):
+            shape, dtype = leaf.shape, str(leaf.dtype)
+            for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                slot = f"a{i}_s{len(my_index)}"
+                my_shards[slot] = np.asarray(shard.data)
+                my_index.append({"leaf": i, "slot": slot,
+                                 "index": _index_to_json(shard.index, shape)})
+        else:
+            arr = np.asarray(leaf)
+            shape, dtype = arr.shape, str(arr.dtype)
+            if proc == 0:
+                slot = f"a{i}_s{len(my_index)}"
+                my_shards[slot] = arr
+                my_index.append(
+                    {"leaf": i, "slot": slot,
+                     "index": _index_to_json((slice(None),) * arr.ndim,
+                                             shape)})
+        leaves_meta.append({"key": key, "shape": list(shape), "dtype": dtype})
+
+    multi = _is_multiprocess()
+    if multi:
+        # Deterministic staging dir: all processes must agree on the name.
+        tmp = os.path.abspath(path) + ".ptmp"
+        if proc == 0:
+            _clear_markers(path)
+            try:
+                shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp, exist_ok=True)
+            except BaseException as e:
+                _mark_failure(path, proc, e)
+        _barrier(f"ckpt-stage:{path}")
+        _check_failures(path)
+    else:
+        # Clear stale markers here too: a failed multi-host save followed by
+        # a single-process retry to the same path must not keep failing on
+        # the dead peer's marker.
+        _clear_markers(path)
+        tmp = tempfile.mkdtemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".")
+    try:
+        try:
+            np.savez(os.path.join(tmp, f"shards-p{proc}.npz"), **my_shards)
+            with open(os.path.join(tmp, f"shard_index-p{proc}.json"),
+                      "w") as f:
+                json.dump(my_index, f)
+        except BaseException as e:
+            if multi:
+                _mark_failure(path, proc, e)
+            raise
+        finally:
+            _barrier(f"ckpt-shards:{path}")
+        _check_failures(path)
+        if proc == 0:
+            try:
+                manifest = {"version": 2, "step": step,
+                            "metadata": metadata or {},
+                            "process_count": jax.process_count(),
+                            "leaves": leaves_meta}
+                with open(os.path.join(tmp, _MANIFEST), "w") as f:
+                    json.dump(manifest, f, indent=1)
+                if os.path.isdir(path):
+                    shutil.rmtree(path)
+                os.replace(tmp, path)
+            except BaseException as e:
+                if multi:
+                    _mark_failure(path, proc, e)
+                raise
+            finally:
+                _barrier(f"ckpt-commit:{path}")
+        else:
+            _barrier(f"ckpt-commit:{path}")
+        _check_failures(path)
+    except BaseException:
+        if proc == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return path
+
+
+class _ShardSource:
+    """Lazy reader over a checkpoint's shard files: loads only the slots
+    whose saved index intersects a requested region, keeping npz handles
+    open across reads. This is what makes multi-host restore scale — a
+    host assembles its own pieces, never the full model."""
+
+    def __init__(self, path: str, manifest: dict):
+        self.path = path
+        self.manifest = manifest
+        self.version = manifest.get("version", 1)
+        # leaf ordinal -> [(concrete index spans, file id, slot)]
+        self.pieces: Dict[int, List[Tuple[Tuple[Tuple[int, int], ...],
+                                          Any, str]]] = {}
+        self._files: Dict[Any, Any] = {}
+        if self.version == 1:
+            for i, meta in enumerate(manifest["leaves"]):
+                spans = tuple((0, d) for d in meta["shape"])
+                self.pieces[i] = [(spans, _ARRAYS, meta["slot"])]
+        else:
+            for p in range(manifest.get("process_count", 1)):
+                index_path = os.path.join(path, f"shard_index-p{p}.json")
+                with open(index_path) as f:
+                    index = json.load(f)
+                fname = f"shards-p{p}.npz"
+                for rec in index:
+                    spans = tuple((a, b) for a, b in rec["index"])
+                    self.pieces.setdefault(rec["leaf"], []).append(
+                        (spans, fname, rec["slot"]))
+
+    def _slot(self, fname: str, slot: str) -> np.ndarray:
+        if fname not in self._files:
+            self._files[fname] = np.load(os.path.join(self.path, fname))
+        return self._files[fname][slot]
+
+    def read_region(self, leaf: int, region: Tuple[slice, ...],
+                    shape: Tuple[int, ...], dtype) -> np.ndarray:
+        want = _normalize(region, shape)
+        rshape = tuple(b - a for a, b in want)
+        total = math.prod(rshape) if rshape else 1
+        recs = self.pieces.get(leaf, [])
+        # fast path: one piece exactly covers the request
+        for spans, fname, slot in recs:
+            if spans == want:
+                return np.asarray(self._slot(fname, slot))
+        out = np.empty(rshape, dtype)
+        filled = 0
+        for spans, fname, slot in recs:
+            inter = []
+            for (ws, we), (ps, pe) in zip(want, spans):
+                s, e = max(ws, ps), min(we, pe)
+                if s >= e:
+                    inter = None
+                    break
+                inter.append((s, e))
+            if inter is None:
+                continue
+            dst = tuple(slice(s - ws, e - ws)
+                        for (s, e), (ws, _) in zip(inter, want))
+            src = tuple(slice(s - ps, e - ps)
+                        for (s, e), (ps, _) in zip(inter, spans))
+            out[dst] = self._slot(fname, slot)[src]
+            filled += math.prod(e - s for s, e in inter) if inter else 1
+        if filled < total:
+            key = self.manifest["leaves"][leaf]["key"]
+            raise FileNotFoundError(
+                f"checkpoint {self.path}: leaf {key!r} region incomplete "
+                f"({filled}/{total} elements); missing shard files?")
+        return out
+
+    def read_full(self, leaf: int) -> np.ndarray:
+        meta = self.manifest["leaves"][leaf]
+        shape = tuple(meta["shape"])
+        return self.read_region(leaf, tuple(slice(0, d) for d in shape),
+                                shape, np.dtype(meta["dtype"]))
+
+    def close(self) -> None:
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files.clear()
+
+
+def load_checkpoint(path: str, target: Optional[Pytree] = None,
+                    shardings: Optional[Pytree] = None) -> Pytree:
+    """Load a checkpoint directory.
+
+    With `target` (a pytree of like-structured arrays/ShapeDtypeStructs) the
+    result mirrors its structure exactly (and validates shapes). Without, a
+    nested dict keyed by path segments is returned. `shardings` (same
+    structure as target) places leaves onto the mesh on load; non-fully-
+    addressable shardings (multi-host) are honoured — each process reads
+    and materialises only its own pieces via jax.make_array_from_callback.
+    """
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    src = _ShardSource(path, manifest)
+    try:
+        key_to_leaf = {meta["key"]: i
+                       for i, meta in enumerate(manifest["leaves"])}
+
+        if target is None:
+            out: Dict[str, Any] = {}
+            for key, i in key_to_leaf.items():
+                node = out
+                parts = key.split("/")
+                for p in parts[:-1]:
+                    node = node.setdefault(p, {})
+                node[parts[-1]] = src.read_full(i)
+            return out
+
+        flat_t = _flatten(target)
+        missing = [k for k, _ in flat_t if k not in key_to_leaf]
+        if missing:
+            raise FileNotFoundError(
+                f"checkpoint {path} missing {len(missing)} leaves, "
+                f"e.g. {missing[:5]}")
+        out_leaves = []
+        shard_flat = _flatten(shardings) if shardings is not None else None
+        for i, (key, ref) in enumerate(flat_t):
+            leaf = key_to_leaf[key]
+            meta = manifest["leaves"][leaf]
+            shape = tuple(meta["shape"])
+            if shape != tuple(ref.shape):
+                raise ValueError(
+                    f"leaf {key}: checkpoint shape {shape} != "
+                    f"target {tuple(ref.shape)}")
+            dtype = getattr(ref, "dtype", np.dtype(meta["dtype"]))
+            if shard_flat is not None:
+                sharding = shard_flat[i][1]
+                memo: Dict[Tuple, np.ndarray] = {}
+
+                def cb(idx, _leaf=leaf, _shape=shape, _dtype=dtype,
+                       _memo=memo):
+                    mk = _normalize(idx, _shape)
+                    if mk not in _memo:
+                        _memo[mk] = src.read_region(
+                            _leaf, idx, _shape, _dtype).astype(
+                                _dtype, copy=False)
+                    return _memo[mk]
+
+                out_leaves.append(jax.make_array_from_callback(
+                    shape, sharding, cb))
+            else:
+                arr = src.read_full(leaf)
+                out_leaves.append(arr.astype(dtype, copy=False)
+                                  if hasattr(ref, "dtype") else arr)
+        treedef = jax.tree_util.tree_structure(target)
+        return jax.tree_util.tree_unflatten(treedef, out_leaves)
+    finally:
+        src.close()
+
+
+def read_metadata(path: str) -> Dict:
+    """Read a checkpoint's manifest metadata dict (without loading data).
+
+    Used to validate structural assumptions on restore, e.g.
+    ShardedEmbedding.validate_checkpoint guards against a num_embeddings
+    change silently misaligning padded table rows."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    return manifest.get("metadata", {}) or {}
+
+
+# Reference-compatible aliases (io.py:441 save_persistables / :657 load).
+save_persistables = save_checkpoint
+load_persistables = load_checkpoint
+
+
+_CKPT_RE = re.compile(r"^ckpt-(\d+)$")
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    best = None
+    for name in os.listdir(directory):
+        m = _CKPT_RE.match(name)
+        if m and os.path.exists(os.path.join(directory, name, _MANIFEST)):
+            step = int(m.group(1))
+            if best is None or step > best[0]:
+                best = (step, os.path.join(directory, name))
+    return best[1] if best else None
+
+
+class CheckpointManager:
+    """Rotation + resume policy over save/load (elastic-recovery story §5.3:
+    restart-from-checkpoint replaces the reference's nonexistent elasticity,
+    and checkpoint-notify becomes a plain directory convention)."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, tree: Pytree, step: int,
+             metadata: Optional[Dict] = None) -> str:
+        path = os.path.join(self.directory, f"ckpt-{step}")
+        save_checkpoint(path, tree, step=step, metadata=metadata)
+        self._gc()
+        return path
+
+    def restore_latest(self, target: Optional[Pytree] = None,
+                       shardings: Optional[Pytree] = None
+                       ) -> Tuple[Optional[Pytree], Optional[int]]:
+        path = latest_checkpoint(self.directory)
+        if path is None:
+            return None, None
+        with open(os.path.join(path, _MANIFEST)) as f:
+            step = json.load(f).get("step")
+        return load_checkpoint(path, target, shardings), step
+
+    def _gc(self) -> None:
+        if _is_multiprocess() and jax.process_index() != 0:
+            return
+        entries = []
+        for name in os.listdir(self.directory):
+            m = _CKPT_RE.match(name)
+            if m:
+                entries.append((int(m.group(1)), name))
+            elif name.endswith(".ptmp") or ".err-p" in name:
+                # Debris from a save that crashed mid-flight (each save
+                # targets a fresh ckpt-{step} path, so its own retry-cleanup
+                # never runs for these): a .ptmp staging dir holds a full
+                # checkpoint's worth of shards and would otherwise leak
+                # forever. Anything still staging belongs to the save in
+                # progress right now — which is ours, already committed.
+                full = os.path.join(self.directory, name)
+                if os.path.isdir(full):
+                    shutil.rmtree(full, ignore_errors=True)
+                else:
+                    try:
+                        os.remove(full)
+                    except OSError:
+                        pass
+        entries.sort()
+        for _, name in entries[:-self.max_to_keep]:
+            shutil.rmtree(os.path.join(self.directory, name),
+                          ignore_errors=True)

@@ -1,0 +1,99 @@
+"""Attention kernels: XLA reference path + Pallas flash attention on TPU.
+
+The reference framework hand-fuses hot patterns in C++/CUDA (operators/fused/,
+attention-adjacent fuse passes ir/attention_lstm_fuse_pass.cc); on TPU the
+equivalent tier is Pallas kernels (see /opt/skills/guides/pallas_guide.md).
+
+Layout convention: q/k/v are [B, T, H, Dh] (batch, time, heads, head_dim).
+`mha` dispatches:
+- Pallas flash attention (paddle_tpu.kernels.flash) when running on TPU and
+  shapes are tile-friendly;
+- an XLA einsum reference path otherwise (CPU tests, odd shapes). Both paths
+  share semantics, so tests on the CPU mesh validate the TPU path's contract.
+
+FLAGS_flash_attention=0 forces the reference path (debugging escape hatch,
+like the reference's FLAGS_cudnn_deterministic).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.utils.flags import FLAGS
+
+FLAGS.define("flash_attention", True,
+             "Use the Pallas flash-attention kernel on TPU when applicable.")
+
+NEG_INF = -1e9
+
+
+def reference_attention(q, k, v, mask=None, scale: Optional[float] = None,
+                        dropout_rng=None, dropout_rate: float = 0.0):
+    """Plain XLA attention. q:[B,Tq,H,D] k/v:[B,Tk,H,D] -> [B,Tq,H,D].
+
+    mask: broadcastable to [B, H, Tq, Tk], True = attend.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.promote_types(logits.dtype, jnp.float32))
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def mha(q, k, v, mask=None, scale: Optional[float] = None,
+        dropout_rng=None, dropout_rate: float = 0.0, causal: bool = False,
+        kv_len: Optional[int] = None):
+    """Dispatching multi-head attention entry point used by model code.
+
+    `causal` and `kv_len` (static right-padding length) are forwarded to the
+    flash kernel, which handles them block-wise — materializing them into a
+    dense `mask` would force the XLA reference path. An explicit `mask`
+    (arbitrary pattern) always uses the reference path.
+    """
+    # The kernel pads ragged sequence lengths to block multiples itself, so
+    # the gate only excludes: shapes where XLA's dense attention is simply
+    # faster, head dims the MXU tiles badly, dropout, and arbitrary dense
+    # masks. Measured on v5e (fwd+bwd, bf16, causal): XLA wins 3.6x at
+    # T=256; flash wins 1.9x at T=1024 and is the only feasible path at
+    # 16k+ (the [B,H,Tq,Tk] score tensor stops fitting) — so the gate is
+    # the kv length crossing 512.
+    use_flash = (FLAGS.get("flash_attention") and _on_tpu()
+                 and mask is None
+                 and dropout_rate == 0.0
+                 and q.shape[1] >= 64 and k.shape[1] >= 512
+                 and q.shape[-1] % 32 == 0 and q.shape[-1] <= 256)
+    if use_flash:
+        from paddle_tpu.kernels import flash
+        return flash.flash_attention(q, k, v, scale=scale, causal=causal,
+                                     kv_len=kv_len)
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        cmask = (jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]
+                 )[None, None]
+        mask = cmask if mask is None else jnp.logical_and(mask, cmask)
+    if kv_len is not None:
+        t_k = k.shape[1]
+        pmask = (jnp.arange(t_k) < kv_len)[None, None, None, :]
+        mask = pmask if mask is None else jnp.logical_and(mask, pmask)
+    return reference_attention(q, k, v, mask=mask, scale=scale,
+                               dropout_rng=dropout_rng,
+                               dropout_rate=dropout_rate)

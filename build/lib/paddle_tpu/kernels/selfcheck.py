@@ -1,0 +1,85 @@
+"""On-hardware flash-attention correctness gate.
+
+CI exercises the Pallas kernels in interpret mode (CPU); the only place
+they execute on a real TPU is the benchmark. A wrong-but-fast kernel
+would ship silently, so the bench calls `flash_selfcheck()` on the real
+device: it runs the flash path and the XLA reference path on the same
+batch — forward AND backward — asserts the flash branch was actually
+taken, and compares numerics (VERDICT r2 weak #2 / next-step #2).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.kernels import attention as A
+from paddle_tpu.utils.flags import FLAGS
+
+
+def flash_selfcheck(batch: int = 2, heads: int = 4, seq: int = 1024,
+                    head_dim: int = 64, causal: bool = True,
+                    dtype=jnp.bfloat16, atol: float = 5e-2) -> Dict:
+    """Compare flash vs reference attention fwd+bwd on one batch.
+
+    Returns {"flash_check": "ok", "max_err": ...} or raises AssertionError.
+    Tolerance is bf16-scale: both paths use fp32 softmax/accumulation, so
+    outputs agree to bf16 rounding.
+    """
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(batch, seq, heads, head_dim), dtype) * 0.3
+    k = jnp.asarray(rs.randn(batch, seq, heads, head_dim), dtype) * 0.3
+    v = jnp.asarray(rs.randn(batch, seq, heads, head_dim), dtype) * 0.3
+
+    # 1. the dispatch gate must choose flash for this shape on this device
+    from paddle_tpu.kernels import flash as flash_mod
+    taken = {"flash": False}
+    orig = flash_mod.flash_attention
+
+    def spy(*args, **kw):
+        taken["flash"] = True
+        return orig(*args, **kw)
+
+    flash_mod.flash_attention, spy_token = spy, None
+    try:
+        def loss_flash(q, k, v):
+            return jnp.sum(A.mha(q, k, v, causal=causal).astype(jnp.float32)
+                           ** 2)
+
+        f_out = A.mha(q, k, v, causal=causal)
+        f_grads = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        flash_mod.flash_attention = orig
+    assert taken["flash"], (
+        "flash_selfcheck: dispatch gate did NOT take the flash path "
+        f"(platform={jax.devices()[0].platform}, "
+        f"flag={FLAGS.get('flash_attention')})")
+
+    # 2. reference path on the same batch
+    def loss_ref(q, k, v):
+        return jnp.sum(A.reference_attention(
+            q, k, v, mask=_causal_mask(seq) if causal else None)
+            .astype(jnp.float32) ** 2)
+
+    r_out = A.reference_attention(
+        q, k, v, mask=_causal_mask(seq) if causal else None)
+    r_grads = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+
+    max_rel = 0.0
+    for a, b in zip((f_out, *f_grads), (r_out, *r_grads)):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        scale = float(jnp.max(jnp.abs(b))) + 1e-6
+        max_rel = max(max_rel, float(jnp.max(jnp.abs(a - b))) / scale)
+    assert max_rel < atol, (
+        f"flash_selfcheck: flash vs reference mismatch: max relative "
+        f"error {max_rel:.4f} (tol {atol})")
+    return {"flash_check": "ok", "flash_max_rel_err": round(max_rel, 5),
+            "flash_platform": jax.devices()[0].platform}
+
+
+def _causal_mask(t: int):
+    return (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None])[None, None]

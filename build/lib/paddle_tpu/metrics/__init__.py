@@ -1,0 +1,5 @@
+from paddle_tpu.metrics.metrics import (
+    Accuracy, Auc, ChunkEvaluator, CompositeMetric, DetectionMAP,
+    EditDistance, MetricBase, Precision, PrecisionRecall, Recall, accuracy,
+    auc,
+)

@@ -1,0 +1,184 @@
+"""SSD-lite detection model family: the end-to-end consumer of the
+detection op family.
+
+Capability-equivalent of the reference's SSD composition
+(/root/reference/python/paddle/fluid/layers/detection.py — ssd_loss:
+match + OHEM + conf/loc losses; multi_box_head; detection_output =
+box_coder + multiclass_nms) built from paddle_tpu.ops.detection primitives
+(prior_box, iou_similarity, encode_boxes_paired, mine_hard_examples,
+multiclass_nms) over a small NHWC conv backbone, trained/evaluated on the
+voc2012 reader with metrics.DetectionMAP.
+
+Static-shape throughout: gt boxes are padded to max_boxes with a validity
+count; NMS output is fixed-size masked rows (the XLA detection idiom).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.nn.layers import BatchNorm, Conv2D
+from paddle_tpu.ops import functional as F
+from paddle_tpu.ops import detection as D
+
+
+class _ConvBNRelu(Module):
+    def __init__(self, features, kernel, stride=1, dtype=jnp.float32):
+        super().__init__()
+        self.conv = Conv2D(features, kernel, stride=stride, padding="SAME",
+                           use_bias=False, dtype=dtype)
+        self.bn = BatchNorm()
+
+    def forward(self, cx: Context, x):
+        return F.relu(self.bn(cx, self.conv(cx, x)))
+
+
+class SSDLite(Module):
+    """Small single-shot detector: two pyramid levels, shared-anchor heads.
+
+    forward(x [B, S, S, 3]) -> (cls_logits [B, P, num_classes + 1],
+    loc [B, P, 4]); class 0 is background (reference ssd_loss
+    background_label=0 convention). `priors()` gives the matching [P, 4]
+    prior boxes (normalized xyxy) and per-coordinate variances.
+    """
+
+    ASPECTS = (1.0, 2.0, 0.5, 3.0)
+
+    @classmethod
+    def _priors_per_cell(cls) -> int:
+        # mirror prior_box's dedupe+flip expansion, +1 for the max_size box
+        ars = [1.0]
+        for ar in cls.ASPECTS:
+            if all(abs(ar - a) > 1e-6 for a in ars):
+                ars.append(ar)
+                if all(abs(1.0 / ar - a) > 1e-6 for a in ars):
+                    ars.append(1.0 / ar)
+        return len(ars) + 1
+
+    def __init__(self, num_classes: int = 20, image_size: int = 96,
+                 dtype=jnp.float32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        a = self._priors_per_cell()
+        self.stem = _ConvBNRelu(32, 3, stride=2, dtype=dtype)     # S/2
+        self.b1 = _ConvBNRelu(64, 3, stride=2, dtype=dtype)       # S/4
+        self.b2 = _ConvBNRelu(128, 3, stride=2, dtype=dtype)      # S/8
+        self.b3 = _ConvBNRelu(128, 3, stride=2, dtype=dtype)      # S/16
+        c = num_classes + 1
+        self.cls1 = Conv2D(a * c, 3, padding="SAME", dtype=dtype)
+        self.loc1 = Conv2D(a * 4, 3, padding="SAME", dtype=dtype)
+        self.cls2 = Conv2D(a * c, 3, padding="SAME", dtype=dtype)
+        self.loc2 = Conv2D(a * 4, 3, padding="SAME", dtype=dtype)
+
+    def _maps(self) -> List[Tuple[int, float, float]]:
+        s = self.image_size
+        return [(s // 8, 0.2, 0.37), (s // 16, 0.37, 0.54)]
+
+    def priors(self):
+        """[P, 4] normalized priors + [4] variances (prior_box op)."""
+        all_boxes = []
+        for fs, mn, mx in self._maps():
+            boxes, var = D.prior_box(
+                (fs, fs), (self.image_size, self.image_size),
+                min_sizes=[mn * self.image_size],
+                max_sizes=[mx * self.image_size],
+                aspect_ratios=list(self.ASPECTS), clip=True)
+            all_boxes.append(boxes.reshape(-1, 4))
+        return jnp.concatenate(all_boxes, axis=0), jnp.asarray(
+            [0.1, 0.1, 0.2, 0.2], jnp.float32)
+
+    def forward(self, cx: Context, x):
+        b = x.shape[0]
+        c = self.num_classes + 1
+        f1 = self.b2(cx, self.b1(cx, self.stem(cx, x)))   # S/8
+        f2 = self.b3(cx, f1)                              # S/16
+        cls = jnp.concatenate(
+            [self.cls1(cx, f1).reshape(b, -1, c),
+             self.cls2(cx, f2).reshape(b, -1, c)], axis=1)
+        loc = jnp.concatenate(
+            [self.loc1(cx, f1).reshape(b, -1, 4),
+             self.loc2(cx, f2).reshape(b, -1, 4)], axis=1)
+        return cls, loc
+
+
+def ssd_match(priors, gt_boxes, gt_labels, num_boxes,
+              overlap_threshold: float = 0.5,
+              prior_var=(0.1, 0.1, 0.2, 0.2)):
+    """Per-image prior↔gt matching (reference ssd_loss matching step).
+
+    priors [P, 4]; gt_boxes [G, 4] (padded); gt_labels [G]; num_boxes
+    scalar. Returns (conf_target [P] int32: 0 bg else label+1,
+    loc_target [P, 4] variance-scaled encoded deltas, pos_mask [P]).
+    """
+    g = gt_boxes.shape[0]
+    valid = jnp.arange(g) < num_boxes
+    iou = D.iou_similarity(gt_boxes, priors)              # [G, P]
+    iou = jnp.where(valid[:, None], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=0)                     # [P]
+    best_iou = jnp.max(iou, axis=0)
+    # force-match the best prior of each valid gt (bipartite step)
+    best_prior = jnp.argmax(iou, axis=1)                  # [G]
+    forced = jnp.zeros(priors.shape[0], bool).at[best_prior].max(valid)
+    forced_gt = jnp.zeros(priors.shape[0], jnp.int32).at[best_prior].max(
+        jnp.where(valid, jnp.arange(g), 0).astype(jnp.int32))
+    pos = forced | (best_iou >= overlap_threshold)
+    gt_idx = jnp.where(forced, forced_gt, best_gt.astype(jnp.int32))
+    matched_box = jnp.take(gt_boxes, gt_idx, axis=0)
+    matched_lbl = jnp.take(gt_labels, gt_idx)
+    conf_target = jnp.where(pos, matched_lbl.astype(jnp.int32) + 1, 0)
+    # variance scaling matches box_coder's decode (which multiplies by
+    # prior_var) so train targets and inference decode are consistent
+    loc_target = D.encode_boxes_paired(priors, matched_box,
+                                       box_normalized=True)
+    loc_target = loc_target / jnp.asarray(prior_var, jnp.float32)
+    loc_target = jnp.where(pos[:, None], loc_target, 0.0)
+    return conf_target, loc_target, pos
+
+
+def ssd_loss(cls_logits, loc, priors, gt_boxes, gt_labels, num_boxes,
+             neg_pos_ratio: float = 3.0):
+    """Batch SSD loss: softmax conf (with OHEM negatives) + smooth-l1 loc
+    (reference layers/detection.py ssd_loss)."""
+    def per_image(cls_i, loc_i, boxes_i, labels_i, nb_i):
+        conf_t, loc_t, pos = ssd_match(priors, boxes_i, labels_i, nb_i)
+        ce = F.softmax_with_cross_entropy(cls_i.astype(jnp.float32),
+                                          conf_t)        # [P]
+        neg = D.mine_hard_examples(ce, jnp.where(pos, 0, -1),
+                                   neg_pos_ratio)
+        keep = pos | neg
+        n_pos = jnp.maximum(jnp.sum(pos), 1)
+        conf_loss = jnp.sum(jnp.where(keep, ce, 0.0)) / n_pos
+        l1 = F.smooth_l1(loc_i.astype(jnp.float32), loc_t)
+        loc_loss = jnp.sum(jnp.where(pos[:, None], l1, 0.0)) / n_pos
+        return conf_loss + loc_loss
+
+    losses = jax.vmap(per_image)(cls_logits, loc, gt_boxes, gt_labels,
+                                 num_boxes)
+    return jnp.mean(losses)
+
+
+def ssd_detect(cls_logits, loc, priors, prior_var,
+               score_threshold: float = 0.3, nms_threshold: float = 0.45,
+               keep_top_k: int = 20):
+    """Decode + multiclass NMS (reference detection_output). Returns
+    per-image [keep_top_k, 6] rows (label, score, x1, y1, x2, y2; label -1
+    padding) + valid counts. Labels are dataset ids (background removed).
+    """
+    def per_image(cls_i, loc_i):
+        probs = jax.nn.softmax(cls_i.astype(jnp.float32), axis=-1)
+        boxes = D.box_coder(priors, prior_var, loc_i, code_type="decode")
+        out, count = D.multiclass_nms(
+            boxes, probs.T, score_threshold=score_threshold,
+            nms_threshold=nms_threshold, keep_top_k=keep_top_k,
+            background_label=0)
+        # shift class ids back to dataset space (drop the background slot)
+        lbl = out[:, 0]
+        out = out.at[:, 0].set(jnp.where(lbl > 0, lbl - 1, -1))
+        return out, count
+
+    return jax.vmap(per_image)(cls_logits, loc)

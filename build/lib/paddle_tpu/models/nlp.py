@@ -1,0 +1,186 @@
+"""NLP + recommendation model zoo.
+
+Capability-equivalent of the reference's language/recommendation models:
+- word2vec (tests/book/test_word2vec.py: N-gram context → next word)
+- stacked-LSTM text classification (benchmark/fluid/models/
+  stacked_dynamic_lstm.py, LSTM headline benchmark README.md:112)
+- RNN encoder-decoder seq2seq (tests/book/test_machine_translation.py,
+  test_rnn_encoder_decoder.py)
+- DeepFM/wide&deep CTR (dist_ctr.py + BASELINE DeepFM target)
+- recommender (tests/book/test_recommender_system.py capability: dual-tower
+  feature fusion)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
+from paddle_tpu.nn.rnn import GRUCell, LSTMCell, RNN, StackedLSTM
+from paddle_tpu.ops import functional as F
+from paddle_tpu.ops.sequence import sequence_mask, sequence_pool
+
+
+class Word2Vec(Module):
+    """CBOW-style N-gram LM (tests/book/test_word2vec.py: 4 context words,
+    shared embedding, concat → fc → softmax)."""
+
+    def __init__(self, vocab: int, embed_dim: int = 32,
+                 hidden: int = 256, context: int = 4):
+        super().__init__()
+        self.embed = Embedding(vocab, embed_dim)
+        self.fc = Linear(hidden)
+        self.head = Linear(vocab)
+        self.context = context
+
+    def forward(self, cx: Context, context_tokens):
+        """context_tokens: [B, context] -> logits [B, V]."""
+        e = self.embed(cx, context_tokens)       # [B, C, E]
+        h = e.reshape(e.shape[0], -1)
+        h = F.relu(self.fc(cx, h))
+        return self.head(cx, h)
+
+
+class TextClassifier(Module):
+    """Stacked-LSTM sentiment classifier (stacked_dynamic_lstm.py; the
+    LSTM text-classification headline benchmark, README.md:112-120)."""
+
+    def __init__(self, vocab: int, embed_dim: int = 128, hidden: int = 512,
+                 layers: int = 2, num_classes: int = 2,
+                 pool: str = "max"):
+        super().__init__()
+        self.embed = Embedding(vocab, embed_dim)
+        self.lstm = StackedLSTM(hidden, layers=layers)
+        self.head = Linear(num_classes)
+        self.pool = pool
+
+    def forward(self, cx: Context, tokens, lengths=None):
+        x = self.embed(cx, tokens)
+        y, _ = self.lstm(cx, x, lengths)
+        if lengths is not None:
+            pooled = sequence_pool(y, lengths, self.pool)
+        else:
+            pooled = jnp.max(y, axis=1)
+        return self.head(cx, pooled)
+
+
+class Seq2Seq(Module):
+    """GRU encoder-decoder with additive attention
+    (tests/book/test_machine_translation.py capability)."""
+
+    def __init__(self, src_vocab: int, trg_vocab: int, embed_dim: int = 128,
+                 hidden: int = 256):
+        super().__init__()
+        self.hidden = hidden
+        self.src_embed = Embedding(src_vocab, embed_dim)
+        self.trg_embed = Embedding(trg_vocab, embed_dim)
+        self.encoder = RNN(GRUCell(hidden))
+        self.dec_cell = GRUCell(hidden)
+        self.attn_q = Linear(hidden, use_bias=False)
+        self.attn_k = Linear(hidden, use_bias=False)
+        self.attn_v = Linear(1, use_bias=False)
+        self.head = Linear(trg_vocab)
+
+    def _attend(self, cx: Context, h, memory, src_maskf):
+        # additive attention: score = v' tanh(Wq h + Wk m)
+        q = self.attn_q(cx, h)[:, None, :]
+        k = self.attn_k(cx, memory)
+        score = self.attn_v(cx, jnp.tanh(q + k))[..., 0]  # [B, Ts]
+        score = jnp.where(src_maskf > 0, score, -1e9)
+        w = jax.nn.softmax(score, axis=-1)
+        return jnp.einsum("bt,btd->bd", w, memory)
+
+    def forward(self, cx: Context, src_tokens, trg_tokens, src_lengths=None):
+        """Teacher-forced training: returns logits [B, Tt, V]."""
+        memory, final = self.encoder(cx, self.src_embed(cx, src_tokens),
+                                     src_lengths)
+        ts = src_tokens.shape[1]
+        maskf = (sequence_mask(src_lengths, ts, jnp.float32)
+                 if src_lengths is not None
+                 else jnp.ones(src_tokens.shape, jnp.float32))
+        emb = self.trg_embed(cx, trg_tokens)     # [B, Tt, E]
+        # pre-bind scoped contexts: scan body must not create params lazily
+        # beyond the first step, so run step 0 pattern via scan directly
+        dec_cx = cx.scope(self.dec_cell._name or "dec_cell")
+
+        def step(h, e_t):
+            ctx_vec = self._attend(cx, h, memory, maskf)
+            inp = jnp.concatenate([e_t, ctx_vec], axis=-1)
+            h2, y = self.dec_cell.forward(dec_cx, h, inp)
+            return h2, y
+
+        h0 = final
+        emb_t = jnp.swapaxes(emb, 0, 1)
+        if cx.is_initializing:
+            # materialise params once outside scan (init trace)
+            h, y0 = step(h0, emb_t[0])
+            ys = jnp.repeat(y0[None], emb_t.shape[0], axis=0)
+        else:
+            _, ys = jax.lax.scan(step, h0, emb_t)
+        out = jnp.swapaxes(ys, 0, 1)
+        return self.head(cx, out)
+
+
+class DeepFM(Module):
+    """DeepFM CTR model (BASELINE DeepFM target; dist_ctr.py capability):
+    dense features + per-field sparse embeddings; FM second-order term +
+    deep MLP tower. The sharded-embedding variant swaps `Embedding` for
+    parallel.embedding.ShardedEmbedding."""
+
+    def __init__(self, num_fields: int, vocab_per_field: int,
+                 dense_dim: int, embed_dim: int = 16,
+                 mlp_dims: Sequence[int] = (400, 400, 400),
+                 embedding_cls=None, **embed_kw):
+        super().__init__()
+        self.num_fields = num_fields
+        cls = embedding_cls or Embedding
+        # one flat table with field offsets (the reference shards one big
+        # lookup table the same way)
+        self.table = cls(num_fields * vocab_per_field, embed_dim, **embed_kw)
+        self.w1 = cls(num_fields * vocab_per_field, 1, **embed_kw)
+        self.vocab_per_field = vocab_per_field
+        self.dense_fc = Linear(embed_dim)
+        self.mlp = [Linear(d) for d in mlp_dims]
+        self.out = Linear(1)
+
+    def forward(self, cx: Context, dense, sparse_ids):
+        """dense: [B, Dd]; sparse_ids: [B, F] per-field ids."""
+        offsets = (jnp.arange(self.num_fields) * self.vocab_per_field)[None]
+        flat_ids = sparse_ids + offsets
+        emb = self.table(cx, flat_ids)                 # [B, F, E]
+        dense_emb = self.dense_fc(cx, dense)[:, None, :]
+        all_emb = jnp.concatenate([emb, dense_emb], axis=1)
+
+        # FM second-order: 0.5 * ((Σv)² - Σv²)
+        s = jnp.sum(all_emb, axis=1)
+        fm = 0.5 * jnp.sum(jnp.square(s) - jnp.sum(jnp.square(all_emb),
+                                                   axis=1), axis=-1)
+        first = jnp.sum(self.w1(cx, flat_ids)[..., 0], axis=-1)
+
+        h = all_emb.reshape(all_emb.shape[0], -1)
+        for fc in self.mlp:
+            h = F.relu(fc(cx, h))
+        deep = self.out(cx, h)[:, 0]
+        return first + fm + deep   # logit
+
+
+class Recommender(Module):
+    """Dual-tower recommender (tests/book/test_recommender_system.py:
+    user tower × item tower cosine score)."""
+
+    def __init__(self, num_users: int, num_items: int, embed_dim: int = 32,
+                 hidden: int = 64):
+        super().__init__()
+        self.user_embed = Embedding(num_users, embed_dim)
+        self.item_embed = Embedding(num_items, embed_dim)
+        self.user_fc = Linear(hidden)
+        self.item_fc = Linear(hidden)
+
+    def forward(self, cx: Context, user_ids, item_ids):
+        u = jnp.tanh(self.user_fc(cx, self.user_embed(cx, user_ids)))
+        i = jnp.tanh(self.item_fc(cx, self.item_embed(cx, item_ids)))
+        return F.cos_sim(u, i) * 5.0  # rating scale 0-5

@@ -1,0 +1,99 @@
+"""Memory-efficient fused BatchNorm+ReLU (training path).
+
+The round-3 roofline analysis (PERF_NOTES.md) showed ResNet-50 training
+is HBM-bound: the dominant traffic is activations saved for backward —
+standard autodiff keeps BOTH the conv output (for BN backward) and the
+post-BN/ReLU output (for the next conv's backward). This custom-vjp
+formulation (the in-place activated-batch-norm idea) reconstructs the
+normalized input from the OUTPUT in backward:
+
+    z = gamma * x_hat + beta        (pre-relu BN output; SAVED)
+    y = relu(z)                     (returned)
+    backward: x_hat = (z - beta) / gamma   — valid at EVERY position
+              relu mask = z > 0
+
+The single saved activation is z: the BN input is never stored (x_hat is
+reconstructed from z), and the relu output y is a free recompute from z,
+so the consumer's backward reads z instead of a separately-stored y —
+one saved tensor per conv+BN+relu block instead of two. (Plain-relu
+output alone would NOT suffice: y == 0 erases x_hat at masked positions
+whose dx still receives batch-statistics gradient terms — that loss of
+information is why in-place ABN uses leaky relu; saving z keeps exact
+relu semantics instead.)
+
+Caveats (why this is a training-bench win and not unconditionally on):
+- gamma must stay away from 0 (reconstruction divides by it); backward
+  clamps |gamma| >= 1e-6, biasing gradients only in that measure-zero
+  case.
+- x_hat is reconstructed from the stored (possibly bf16) y, so gradients
+  carry bf16 rounding of y — the same precision class as bf16 training
+  itself (production in-place-ABN ships this trade).
+
+Enable via BatchNorm(fuse_relu=True) or call bn_relu_train directly.
+The vision tower deliberately keeps the PLAIN formulation: measured on
+v5e, XLA's conv+stats fusions already avoid the double save, so this
+path changed neither step time nor memory there (PERF_NOTES.md
+addendum) — it exists for backends/compilers where that is not true.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def bn_relu_train(x, gamma, beta, eps: float):
+    """relu(batch_norm(x)) over NHWC-style layouts (features last).
+
+    x: [..., C] (stats over all leading axes); gamma/beta: [C] fp32.
+    Returns (y [..., C] in x.dtype, mean [C] f32, var [C] f32) — mean/var
+    feed the running-stat EMA outside (they carry no gradient).
+    """
+    y, _, mean, var, _ = _bn_relu_fwd_math(x, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_relu_fwd_math(x, gamma, beta, eps):
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(xf, axis=axes)
+    mean2 = jnp.mean(jnp.square(xf), axis=axes)
+    var = jnp.maximum(mean2 - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    z = (xf - mean) * (inv * gamma) + beta
+    z = z.astype(x.dtype)
+    return jax.nn.relu(z), z, mean, var, inv
+
+
+def _bn_relu_fwd(x, gamma, beta, eps):
+    y, z, mean, var, inv = _bn_relu_fwd_math(x, gamma, beta, eps)
+    # residuals deliberately EXCLUDE x: z (pre-relu output) is the ONE
+    # saved activation — y is a free relu recompute from it and x_hat
+    # reconstructs from it at every position; the rest are [C] vectors
+    return (y, mean, var), (z, gamma, beta, inv)
+
+
+def _bn_relu_bwd(eps, res, cotangents):
+    z, gamma, beta, inv = res
+    dy = cotangents[0].astype(jnp.float32)     # d(mean)/d(var) unused
+    zf = z.astype(jnp.float32)
+    g = jnp.where(zf > 0, dy, 0.0)             # relu mask from z
+    gamma_safe = jnp.where(jnp.abs(gamma) < 1e-6,
+                           jnp.where(gamma < 0, -1e-6, 1e-6), gamma)
+    x_hat = (zf - beta) / gamma_safe           # valid everywhere
+    axes = tuple(range(z.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= z.shape[a]
+    dbeta = jnp.sum(g, axis=axes)
+    dgamma = jnp.sum(g * x_hat, axis=axes)
+    dx = (gamma * inv) * (g - (x_hat * dgamma + dbeta) / n)
+    return dx.astype(z.dtype), dgamma, dbeta
+
+
+bn_relu_train.defvjp(_bn_relu_fwd, _bn_relu_bwd)

@@ -1,0 +1,140 @@
+"""Parameter initializers.
+
+Capability parity with reference initializer.py:125-710 (Constant, Uniform,
+Normal, TruncatedNormal, Xavier, MSRA/Kaiming, Bilinear, NumpyArray). The
+reference emits init *ops* into a startup program; here an initializer is a
+pure function `(rng, shape, dtype) -> array` consumed by `Context.param`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fans(shape: Sequence[int]) -> tuple:
+    """fan_in/fan_out matching conv (O, I, kh, kw ordering-agnostic) and fc."""
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels here are (kh, kw, in, out) — JAX/NHWC convention
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def constant(value: float = 0.0):
+    def init(rng, shape, dtype):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def uniform(low: float = -1.0, high: float = 1.0):
+    def init(rng, shape, dtype):
+        return jax.random.uniform(rng, shape, jnp.float32, low, high).astype(dtype)
+    return init
+
+
+def normal(mean: float = 0.0, std: float = 1.0):
+    def init(rng, shape, dtype):
+        return (jax.random.normal(rng, shape, jnp.float32) * std + mean).astype(dtype)
+    return init
+
+
+def truncated_normal(mean: float = 0.0, std: float = 1.0):
+    def init(rng, shape, dtype):
+        x = jax.random.truncated_normal(rng, -2.0, 2.0, shape, jnp.float32)
+        return (x * std + mean).astype(dtype)
+    return init
+
+
+def xavier(uniform_dist: bool = True, fan_in: int = None, fan_out: int = None):
+    """Glorot init (reference XavierInitializer, initializer.py:327)."""
+    def init(rng, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        fo = fan_out if fan_out is not None else fo
+        if uniform_dist:
+            limit = math.sqrt(6.0 / (fi + fo))
+            x = jax.random.uniform(rng, shape, jnp.float32, -limit, limit)
+        else:
+            std = math.sqrt(2.0 / (fi + fo))
+            x = jax.random.normal(rng, shape, jnp.float32) * std
+        return x.astype(dtype)
+    return init
+
+
+glorot_uniform = xavier(True)
+glorot_normal = xavier(False)
+
+
+def msra(uniform_dist: bool = False, fan_in: int = None):
+    """Kaiming/He init (reference MSRAInitializer, initializer.py:427)."""
+    def init(rng, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = fan_in if fan_in is not None else fi
+        if uniform_dist:
+            limit = math.sqrt(6.0 / fi)
+            x = jax.random.uniform(rng, shape, jnp.float32, -limit, limit)
+        else:
+            std = math.sqrt(2.0 / fi)
+            x = jax.random.normal(rng, shape, jnp.float32) * std
+        return x.astype(dtype)
+    return init
+
+
+kaiming_normal = msra(False)
+
+
+def bilinear():
+    """Bilinear upsample kernel init for transposed conv (initializer.py:529).
+
+    Kernel layout (kh, kw, in, out).
+    """
+    def init(rng, shape, dtype):
+        kh, kw, cin, cout = shape
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        w = np.zeros(shape, np.float32)
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        for i in range(min(cin, cout)):
+            w[:, :, i, i] = filt
+        return jnp.asarray(w, dtype)
+    return init
+
+
+def numpy_array(arr) -> Any:
+    """Init from a concrete array (reference NumpyArrayInitializer)."""
+    def init(rng, shape, dtype):
+        a = jnp.asarray(arr, dtype)
+        if tuple(a.shape) != tuple(shape):
+            raise ValueError(f"numpy_array init shape {a.shape} != {shape}")
+        return a
+    return init
+
+
+def orthogonal(scale: float = 1.0):
+    """Orthogonal init (RNN recurrent weights; standard practice the
+    reference reaches via numpy + NumpyArrayInitializer)."""
+    def init(rng, shape, dtype):
+        n_rows = shape[0]
+        n_cols = int(np.prod(shape[1:]))
+        mat = jax.random.normal(rng, (max(n_rows, n_cols),
+                                      min(n_rows, n_cols)), jnp.float32)
+        q, r = jnp.linalg.qr(mat)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if n_rows < n_cols:
+            q = q.T
+        return (scale * q[:n_rows, :n_cols]).reshape(shape).astype(dtype)
+    return init

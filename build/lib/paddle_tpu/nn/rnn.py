@@ -1,0 +1,187 @@
+"""Recurrent layers: LSTM/GRU cells and scan-driven sequence layers.
+
+Capability-equivalent of the reference RNN stack:
+- lstm/gru compute kernels (operators/math/lstm_compute.*, gru_compute.*,
+  operators/lstm_op.cc, gru_op.cc, fused cudnn lstm layers/nn.py:491)
+- DynamicRNN (layers/control_flow.py:1395): while-op + lod_rank_table +
+  shrink_memory executing ragged batches step-by-step. TPU-native form:
+  `lax.scan` over the padded time axis with per-step masking — identical
+  math (finished rows freeze their state), static shapes, fully fused by
+  XLA instead of interpreted per-step by a nested Executor (while_op.cc:50).
+- StaticRNN (control_flow.py:278): scan with no masking.
+
+Layout: time-major scan internally ([T, B, D]) — the fastest layout for
+lax.scan on TPU — with batch-major [B, T, D] at the API boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.nn import initializers as I
+from paddle_tpu.nn.layers import Linear
+
+
+class LSTMCell(Module):
+    """Standard LSTM cell (operators/math/lstm_compute: i,f,c,o gates).
+
+    `proj_size` adds a recurrent output projection (reference lstmp op,
+    operators/lstmp_op.cc): h is projected to proj_size before recurrence.
+    """
+
+    def __init__(self, hidden: int, forget_bias: float = 1.0,
+                 proj_size: int = 0, dtype=jnp.float32):
+        super().__init__()
+        self.hidden = hidden
+        self.forget_bias = forget_bias
+        self.proj_size = proj_size
+        self.dtype = dtype
+
+    def forward(self, cx: Context, carry, x):
+        h, c = carry
+        d = x.shape[-1]
+        h_dim = self.proj_size or self.hidden
+        wx = cx.param("wx", (d, 4 * self.hidden), I.glorot_uniform)
+        wh = cx.param("wh", (h_dim, 4 * self.hidden), I.orthogonal())
+        b = cx.param("bias", (4 * self.hidden,), I.zeros)
+        z = (x.astype(self.dtype) @ wx.astype(self.dtype)
+             + h.astype(self.dtype) @ wh.astype(self.dtype)
+             + b.astype(self.dtype))
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        new_c = (jax.nn.sigmoid(f + self.forget_bias) * c
+                 + jax.nn.sigmoid(i) * jnp.tanh(g))
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        if self.proj_size:
+            wp = cx.param("wp", (self.hidden, self.proj_size),
+                          I.glorot_uniform)
+            new_h = new_h @ wp.astype(new_h.dtype)
+        return (new_h, new_c), new_h
+
+    def init_carry(self, batch: int):
+        h = jnp.zeros((batch, self.proj_size or self.hidden), self.dtype)
+        return (h, jnp.zeros((batch, self.hidden), self.dtype))
+
+
+class GRUCell(Module):
+    """GRU cell (operators/math/gru_compute, gru_op.cc)."""
+
+    def __init__(self, hidden: int, dtype=jnp.float32):
+        super().__init__()
+        self.hidden = hidden
+        self.dtype = dtype
+
+    def forward(self, cx: Context, carry, x):
+        h = carry
+        d = x.shape[-1]
+        wx = cx.param("wx", (d, 3 * self.hidden), I.glorot_uniform)
+        wh = cx.param("wh", (self.hidden, 3 * self.hidden), I.orthogonal())
+        b = cx.param("bias", (3 * self.hidden,), I.zeros)
+        xz = x.astype(self.dtype) @ wx.astype(self.dtype) + b
+        hz = h.astype(self.dtype) @ wh.astype(self.dtype)
+        xr, xu, xn = jnp.split(xz, 3, axis=-1)
+        hr, hu, hn = jnp.split(hz, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        u = jax.nn.sigmoid(xu + hu)
+        n = jnp.tanh(xn + r * hn)
+        new_h = (1 - u) * n + u * h
+        return new_h, new_h
+
+    def init_carry(self, batch: int):
+        return jnp.zeros((batch, self.hidden), self.dtype)
+
+
+def _scan_cell(cell: Module, cx: Context, x_bt, carry, lengths=None,
+               reverse: bool = False):
+    """Run a cell over [B, T, D] with optional length masking.
+
+    Masking implements the DynamicRNN semantics: once t >= length(row), the
+    row's carry stops updating (shrink_memory capability) and its output is
+    zeroed — matching what LoD-aware per-sequence execution computes.
+    """
+    xt = jnp.swapaxes(x_bt, 0, 1)  # [T, B, D]
+    t_total = xt.shape[0]
+    # cell must see a Context scoped like a direct child call
+    name = cell._name or type(cell).__name__
+    ccx = cx.scope(name)
+
+    def step(carry_t, inp):
+        x_t, t = inp
+        new_carry, y = cell.forward(ccx, carry_t, x_t)
+        if lengths is not None:
+            tt = (t_total - 1 - t) if reverse else t
+            alive = (lengths > tt)
+            amask = alive[:, None].astype(y.dtype)
+
+            def mix(new, old):
+                return new * amask + old * (1 - amask)
+            new_carry = jax.tree.map(mix, new_carry, carry_t)
+            y = y * amask
+        return new_carry, y
+
+    if cx.is_initializing:
+        # Materialise params with ONE unrolled step: creating params inside
+        # a traced scan body would leak tracers into the variables tree.
+        new_carry, y0 = cell.forward(ccx, carry, xt[0])
+        ys = jnp.broadcast_to(y0[None], (t_total,) + y0.shape)
+        return new_carry, jnp.swapaxes(ys, 0, 1)
+
+    ts = jnp.arange(t_total)
+    if reverse:
+        xt = xt[::-1]
+    final, ys = lax.scan(step, carry, (xt, ts))
+    if reverse:
+        ys = ys[::-1]
+    return final, jnp.swapaxes(ys, 0, 1)
+
+
+class RNN(Module):
+    """Single-direction recurrent layer over padded batches.
+
+    ≈ fluid.layers.lstm / DynamicRNN with one memory. Returns
+    (outputs [B,T,H], final_carry)."""
+
+    def __init__(self, cell: Module, reverse: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.reverse = reverse
+
+    def forward(self, cx: Context, x, lengths=None, initial_carry=None):
+        carry = (initial_carry if initial_carry is not None
+                 else self.cell.init_carry(x.shape[0]))
+        final, ys = _scan_cell(self.cell, cx, x, carry, lengths,
+                               self.reverse)
+        return ys, final
+
+
+class BiRNN(Module):
+    """Bidirectional wrapper (≈ stacked fwd+bwd lstm idiom in the
+    reference's label_semantic_roles book model)."""
+
+    def __init__(self, fwd_cell: Module, bwd_cell: Module):
+        super().__init__()
+        self.fwd = RNN(fwd_cell)
+        self.bwd = RNN(bwd_cell, reverse=True)
+
+    def forward(self, cx: Context, x, lengths=None):
+        yf, cf = self.fwd(cx, x, lengths)
+        yb, cb = self.bwd(cx, x, lengths)
+        return jnp.concatenate([yf, yb], axis=-1), (cf, cb)
+
+
+class StackedLSTM(Module):
+    """N-layer LSTM (benchmark/fluid/models/stacked_dynamic_lstm.py)."""
+
+    def __init__(self, hidden: int, layers: int = 2, dtype=jnp.float32):
+        super().__init__()
+        self.rnns = [RNN(LSTMCell(hidden, dtype=dtype))
+                     for _ in range(layers)]
+
+    def forward(self, cx: Context, x, lengths=None):
+        for rnn in self.rnns:
+            x, final = rnn(cx, x, lengths)
+        return x, final

@@ -1,0 +1,139 @@
+"""Sampled / factorized softmax layers for large vocabularies.
+
+Capability-equivalent of the reference's large-vocab output layers:
+- nce op (/root/reference/paddle/fluid/operators/nce_op.cc: noise-
+  contrastive estimation with uniform/custom negative sampling);
+- hierarchical_sigmoid op (hierarchical_sigmoid_op.cc: complete-binary-
+  tree Huffman-style factorization; word2vec-era output layer).
+
+Both avoid materialising the full [B, V] logits during training; at
+inference `full_logits` gives the dense scores.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.nn import initializers as I
+
+
+class NCE(Module):
+    """Noise-contrastive estimation output layer (nce op).
+
+    forward(cx, x, labels) -> per-example NCE loss. Samples
+    `num_neg` uniform negatives per example (the reference's default
+    uniform sampler; custom_dist maps to `probs`)."""
+
+    def __init__(self, num_classes: int, num_neg: int = 16,
+                 probs=None, dtype=jnp.float32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.num_neg = num_neg
+        self.probs = probs
+        self.dtype = dtype
+
+    def forward(self, cx: Context, x, labels):
+        d = x.shape[-1]
+        w = cx.param("weight", (self.num_classes, d), I.glorot_uniform,
+                     self.dtype)
+        b = cx.param("bias", (self.num_classes,), I.zeros, self.dtype)
+        bsz = x.shape[0]
+        labels = labels.astype(jnp.int32)
+
+        if self.probs is None:
+            logq = jnp.full((), -jnp.log(self.num_classes))
+            neg = jax.random.randint(cx.rng(), (bsz, self.num_neg), 0,
+                                     self.num_classes)
+            logq_pos = jnp.broadcast_to(logq, (bsz,))
+            logq_neg = jnp.full((bsz, self.num_neg), logq)
+        else:
+            probs = jnp.asarray(self.probs)
+            neg = jax.random.categorical(
+                cx.rng(), jnp.log(probs)[None].repeat(bsz, 0),
+                shape=(bsz, self.num_neg))
+            logq_pos = jnp.log(probs[labels] + 1e-12)
+            logq_neg = jnp.log(probs[neg] + 1e-12)
+
+        pos_logit = jnp.sum(x * w[labels], -1) + b[labels]
+        neg_logit = jnp.einsum("bd,bkd->bk", x, w[neg]) + b[neg]
+        # NCE: classify true vs noise with logit corrected by log(k*q)
+        k = float(self.num_neg)
+        pos_score = pos_logit - (jnp.log(k) + logq_pos)
+        neg_score = neg_logit - (jnp.log(k) + logq_neg)
+        pos_loss = jax.nn.softplus(-pos_score)
+        neg_loss = jnp.sum(jax.nn.softplus(neg_score), axis=-1)
+        return pos_loss + neg_loss
+
+    def full_logits(self, cx: Context, x):
+        """Dense [B, V] logits for inference."""
+        d = x.shape[-1]
+        w = cx.param("weight", (self.num_classes, d), I.glorot_uniform,
+                     self.dtype)
+        b = cx.param("bias", (self.num_classes,), I.zeros, self.dtype)
+        return x @ w.T + b
+
+
+class HierarchicalSigmoid(Module):
+    """Complete-binary-tree hierarchical sigmoid (hierarchical_sigmoid
+    op's default non-custom-tree mode): classes are leaves of a complete
+    binary tree with `num_classes - 1` internal nodes; the loss is the sum
+    of binary decisions along the root->leaf path (depth ceil(log2 V))."""
+
+    def __init__(self, num_classes: int, dtype=jnp.float32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.dtype = dtype
+        # Reference layout (MatrixBitCodeFunctor, operators/math/
+        # matrix_bit_code.h): leaf c has code c + num_classes in a
+        # complete binary tree over internal nodes 1..num_classes-1
+        # (1-indexed heap); decision bit at each step is the child parity.
+        import numpy as np
+        depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+        paths = np.zeros((num_classes, depth), np.int32)
+        bits = np.zeros((num_classes, depth), np.float32)
+        mask = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + num_classes        # heap position of the leaf
+            steps = []
+            while node > 1:
+                steps.append((node // 2, float(node % 2)))
+                node //= 2
+            steps.reverse()
+            for d, (internal, bit) in enumerate(steps):
+                paths[c, d] = internal - 1   # internal nodes 0-indexed
+                bits[c, d] = bit
+                mask[c, d] = 1.0
+        self._paths = jnp.asarray(paths)
+        self._bits = jnp.asarray(bits)
+        self._mask = jnp.asarray(mask)
+
+    def forward(self, cx: Context, x, labels):
+        """Per-example hierarchical softmax NLL."""
+        d = x.shape[-1]
+        w = cx.param("weight", (self.num_classes, d), I.glorot_uniform,
+                     self.dtype)
+        b = cx.param("bias", (self.num_classes,), I.zeros, self.dtype)
+        labels = labels.astype(jnp.int32)
+        nodes = self._paths[labels]          # [B, depth]
+        bits = self._bits[labels]
+        mask = self._mask[labels]
+        logits = jnp.einsum("bd,bkd->bk", x, w[nodes]) + b[nodes]
+        # bit=1 -> right child: P = sigmoid(logit); bit=0 -> 1-sigmoid
+        nll = jax.nn.softplus(jnp.where(bits > 0, -logits, logits))
+        return jnp.sum(nll * mask, axis=-1)
+
+    def full_log_probs(self, cx: Context, x):
+        """Dense [B, V] log-probabilities (inference path)."""
+        d = x.shape[-1]
+        w = cx.param("weight", (self.num_classes, d), I.glorot_uniform,
+                     self.dtype)
+        b = cx.param("bias", (self.num_classes,), I.zeros, self.dtype)
+        logits = x @ w.T + b                  # [B, V-ish internal nodes]
+        node_logit = logits[:, self._paths]   # [B, V, depth]
+        lp = -jax.nn.softplus(
+            jnp.where(self._bits[None] > 0, -node_logit, node_logit))
+        return jnp.sum(lp * self._mask[None], axis=-1)

@@ -1,0 +1,8 @@
+from paddle_tpu.ops.functional import *  # noqa: F401,F403
+from paddle_tpu.ops import (
+    control_flow, detection, extras, functional, lattice, sequence)
+from paddle_tpu.ops.lattice import (
+    crf_decoding, ctc_align, ctc_loss, linear_chain_crf)
+from paddle_tpu.ops.beam_search import BeamResult, beam_search, tile_beams
+from paddle_tpu.ops.control_flow import (
+    case, cond, fori_loop, piecewise, static_rnn, switch, while_loop)

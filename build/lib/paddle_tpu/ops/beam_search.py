@@ -1,0 +1,165 @@
+"""Beam search decoding.
+
+Capability-equivalent of the reference decode stack:
+- beam_search op (operators/beam_search_op.cc, math/beam_search.cu):
+  per-step top-k expansion with per-beam end-token handling;
+- beam_search_decode op (beam_search_decode_op.cc): backtracking the
+  selected-parent lattice into final token sequences.
+
+TPU-native formulation: the whole decode is ONE `lax.scan` over decode
+positions with static shapes [batch, beams, ...]; finished beams are frozen
+with masking (the reference shrinks the beam set dynamically — we keep
+static shapes and mask, the standard XLA idiom). Backtracking is a second
+scan over the recorded parent pointers.
+
+`decode_fn(tokens [B*K], pos, state) -> (log_probs [B*K, V], new_state)`
+abstracts the model (Transformer.decode_step with KV caches in `state`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+class BeamResult(NamedTuple):
+    tokens: jax.Array      # [B, K, T] decoded ids (eos-padded)
+    scores: jax.Array      # [B, K] total log-prob (length-normalised)
+    lengths: jax.Array     # [B, K]
+
+
+def beam_search(decode_fn: Callable, init_state: Any, batch: int,
+                beam_size: int, max_len: int, bos_id: int, eos_id: int,
+                vocab_size: int, length_penalty: float = 0.0,
+                early_exit: bool = False) -> BeamResult:
+    """Run beam search. `init_state` is a pytree whose leaves have leading
+    dim B*K (tile per-sample state beam_size times first — see
+    `tile_beams`).
+
+    early_exit=True runs the decode as a `lax.while_loop` that stops as
+    soon as every beam has emitted eos (the length-adaptive capability of
+    the reference's While-op-based dynamic decode, control_flow.py:1395 +
+    beam_search_op) instead of always scanning max_len positions. Output
+    buffers keep the static [B, K, max_len] shape; only the trip count is
+    dynamic, so XLA still compiles one program.
+    """
+    bk = batch * beam_size
+
+    # initial beams: beam 0 live with score 0, others -inf (standard trick
+    # so step 0 expands only one copy)
+    init_scores = jnp.full((batch, beam_size), NEG_INF, jnp.float32)
+    init_scores = init_scores.at[:, 0].set(0.0)
+    init_tokens = jnp.full((bk,), bos_id, jnp.int32)
+    init_finished = jnp.zeros((batch, beam_size), jnp.bool_)
+    init_lengths = jnp.zeros((batch, beam_size), jnp.int32)
+
+    def expand(tokens, scores, finished, lengths, state, pos):
+        """One beam expansion at position `pos` (beam_search_op body)."""
+        log_probs, new_state = decode_fn(tokens, pos, state)
+        log_probs = log_probs.reshape(batch, beam_size, vocab_size)
+        log_probs = jax.nn.log_softmax(log_probs.astype(jnp.float32), -1)
+
+        # finished beams: only eos continues, with zero added score
+        eos_only = jnp.full((vocab_size,), NEG_INF).at[eos_id].set(0.0)
+        log_probs = jnp.where(finished[..., None], eos_only[None, None],
+                              log_probs)
+
+        cand = scores[..., None] + log_probs          # [B, K, V]
+        flat = cand.reshape(batch, beam_size * vocab_size)
+        top_scores, top_idx = lax.top_k(flat, beam_size)
+        parent = top_idx // vocab_size                # [B, K]
+        token = (top_idx % vocab_size).astype(jnp.int32)
+
+        # gather parent state rows
+        flat_parent = (parent
+                       + jnp.arange(batch)[:, None] * beam_size).reshape(-1)
+        new_state = jax.tree.map(
+            lambda x: jnp.take(x, flat_parent, axis=0), new_state)
+        new_finished = jnp.take_along_axis(finished, parent, axis=1) \
+            | (token == eos_id)
+        parent_len = jnp.take_along_axis(lengths, parent, axis=1)
+        was_fin = jnp.take_along_axis(finished, parent, axis=1)
+        new_lengths = jnp.where(was_fin, parent_len, parent_len + 1)
+        return token, parent, top_scores, new_finished, new_lengths, new_state
+
+    if early_exit:
+        # identity parents + eos tokens in unwritten tail positions keep
+        # the backtrack pass correct for early-stopped decodes
+        tok_hist0 = jnp.full((max_len, batch, beam_size), eos_id, jnp.int32)
+        parent_hist0 = jnp.tile(
+            jnp.arange(beam_size, dtype=jnp.int32)[None, None],
+            (max_len, batch, 1))
+
+        def w_cond(carry):
+            t, _, _, finished, _, _, _, _ = carry
+            return jnp.logical_and(t < max_len, ~jnp.all(finished))
+
+        def w_body(carry):
+            (t, tokens, scores, finished, lengths, state,
+             tok_hist, parent_hist) = carry
+            token, parent, scores, finished, lengths, state = expand(
+                tokens, scores, finished, lengths, state, t)
+            tok_hist = tok_hist.at[t].set(token)
+            parent_hist = parent_hist.at[t].set(parent)
+            return (t + 1, token.reshape(-1), scores, finished, lengths,
+                    state, tok_hist, parent_hist)
+
+        carry = (jnp.zeros((), jnp.int32), init_tokens, init_scores,
+                 init_finished, init_lengths, init_state,
+                 tok_hist0, parent_hist0)
+        (_, _, final_scores, _, final_lengths, _, tok_hist,
+         parent_hist) = lax.while_loop(w_cond, w_body, carry)
+    else:
+        def step(carry, pos):
+            tokens, scores, finished, lengths, state = carry
+            token, parent, scores, finished, lengths, state = expand(
+                tokens, scores, finished, lengths, state, pos)
+            new_carry = (token.reshape(-1), scores, finished, lengths, state)
+            return new_carry, (token, parent)
+
+        carry = (init_tokens, init_scores, init_finished, init_lengths,
+                 init_state)
+        carry, (tok_hist, parent_hist) = lax.scan(
+            step, carry, jnp.arange(max_len))
+        _, final_scores, _, final_lengths, _ = carry
+
+    # ---- backtrack (beam_search_decode capability) ----
+    def back_step(beam_idx, t):
+        tok = jnp.take_along_axis(tok_hist[t], beam_idx, axis=1)
+        par = jnp.take_along_axis(parent_hist[t], beam_idx, axis=1)
+        return par, tok
+
+    beam_idx = jnp.tile(jnp.arange(beam_size)[None], (batch, 1))
+    _, toks_rev = lax.scan(back_step, beam_idx,
+                           jnp.arange(max_len - 1, -1, -1))
+    tokens = jnp.moveaxis(toks_rev[::-1], 0, -1)     # [B, K, T]
+    # pad after eos with eos
+    pos = jnp.arange(max_len)[None, None]
+    tokens = jnp.where(pos < final_lengths[..., None], tokens, eos_id)
+
+    if length_penalty > 0:
+        denom = ((5.0 + final_lengths.astype(jnp.float32)) / 6.0) \
+            ** length_penalty
+        norm_scores = final_scores / denom
+    else:
+        norm_scores = final_scores
+
+    # sort beams by score
+    order = jnp.argsort(-norm_scores, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[..., None], axis=1)
+    norm_scores = jnp.take_along_axis(norm_scores, order, axis=1)
+    final_lengths = jnp.take_along_axis(final_lengths, order, axis=1)
+    return BeamResult(tokens=tokens, scores=norm_scores,
+                      lengths=final_lengths)
+
+
+def tile_beams(tree: Any, beam_size: int) -> Any:
+    """Repeat each leading-dim row beam_size times ([B,...] -> [B*K,...])."""
+    def rep(x):
+        return jnp.repeat(x, beam_size, axis=0)
+    return jax.tree.map(rep, tree)

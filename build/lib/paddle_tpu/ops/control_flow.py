@@ -1,0 +1,154 @@
+"""Control-flow ops: while / cond / switch / case under XLA tracing.
+
+Capability-equivalent of the reference control-flow stack:
+- While op running a sub-block via a nested Executor
+  (operators/controlflow/while_op.cc:50; python While
+  layers/control_flow.py:504) -> `while_loop` over `lax.while_loop`;
+- conditional_block / IfElse (controlflow/conditional_block_op.cc;
+  control_flow.py:1265) -> `cond`;
+- Switch (control_flow.py:1139, piecewise scalar cases used by LR
+  schedules) -> `switch` / `piecewise`;
+- StaticRNN (control_flow.py:278) -> `static_rnn` over `lax.scan`;
+- DynamicRNN (control_flow.py:1395) + lod_rank_table/shrink_memory:
+  subsumed by scan + masking (ops/sequence.py shrink_memory) — variable
+  lengths are handled by masks, not dynamic shapes, which is the only
+  formulation XLA can tile for the MXU.
+
+Everything here is jit-safe: predicates are traced scalars, both branches
+compile, trip counts are data-dependent only inside lax.while_loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Pytree = Any
+
+
+def while_loop(cond_fn: Callable[[Pytree], jax.Array],
+               body_fn: Callable[[Pytree], Pytree],
+               init: Pytree,
+               max_iter: Optional[int] = None) -> Pytree:
+    """`while cond_fn(x): x = body_fn(x)` with pytree state.
+
+    max_iter (optional) adds a hard trip-count bound — the analog of the
+    reference's is_test/early-termination guards, and the escape hatch
+    that keeps accidental infinite loops from hanging a TPU program.
+    """
+    if max_iter is None:
+        return lax.while_loop(cond_fn, body_fn, init)
+
+    def c(carry):
+        i, x = carry
+        return jnp.logical_and(i < max_iter, cond_fn(x))
+
+    def b(carry):
+        i, x = carry
+        return i + 1, body_fn(x)
+
+    return lax.while_loop(c, b, (jnp.zeros((), jnp.int32), init))[1]
+
+
+def fori_loop(lower, upper, body_fn: Callable[[Any, Pytree], Pytree],
+              init: Pytree) -> Pytree:
+    """`for i in range(lower, upper): x = body_fn(i, x)` (static or traced
+    bounds; lax.fori_loop semantics)."""
+    return lax.fori_loop(lower, upper, body_fn, init)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, *operands) -> Pytree:
+    """Two-way conditional; both branches are traced, one executes.
+    (conditional_block / IfElse capability.)"""
+    return lax.cond(pred, true_fn, false_fn, *operands)
+
+
+def switch(index, branches: Sequence[Callable], *operands) -> Pytree:
+    """N-way branch by integer index (clamped to range, lax.switch)."""
+    return lax.switch(index, branches, *operands)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Optional[Callable] = None,
+         operands: Tuple = ()) -> Pytree:
+    """First-match-wins conditional chain (layers.case capability,
+    reference Switch semantics control_flow.py:1139): evaluates to the fn
+    of the first true predicate, else `default`. Branch fns are called
+    with *operands (keyword arg — a positional tuple after `default` would
+    be swallowed as the default callable)."""
+    if default is None:
+        *pairs, (last_pred, last_fn) = pred_fn_pairs
+        default = last_fn
+        pred_fn_pairs = pairs
+
+    out = default(*operands)
+    # fold right-to-left so the FIRST true predicate wins
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        out = lax.cond(pred, lambda ops, f=fn: f(*ops),
+                       lambda ops, o=out: o, operands)
+    return out
+
+
+def piecewise(x, boundaries: Sequence[float], values: Sequence[Any]):
+    """Piecewise-constant lookup: values[i] where x < boundaries[i], else
+    values[-1] (the Switch idiom behind piecewise_decay LR schedules,
+    learning_rate_scheduler.py piecewise_decay)."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("need len(values) == len(boundaries) + 1")
+    b = jnp.asarray(boundaries)
+    idx = jnp.sum(jnp.asarray(x) >= b)
+    return jnp.asarray(jnp.stack([jnp.asarray(v) for v in values]))[idx]
+
+
+def static_rnn(step_fn: Callable[[Pytree, Pytree], Tuple[Pytree, Pytree]],
+               inputs: Pytree, init_state: Pytree,
+               lengths: Optional[jax.Array] = None,
+               reverse: bool = False) -> Tuple[Pytree, Pytree]:
+    """Unrolled-in-time RNN over [B, T, ...] inputs via lax.scan
+    (StaticRNN capability, control_flow.py:278; DynamicRNN's ragged
+    handling comes from `lengths` masking ≈ shrink_memory).
+
+    step_fn(state, x_t) -> (new_state, y_t). Returns (ys [B, T, ...],
+    final_state); with `lengths`, state freezes past each row's length and
+    final_state is the last *valid* state (reverse runs right-to-left).
+    """
+    t = jax.tree_util.tree_leaves(inputs)[0].shape[1]
+
+    def scan_body(carry, t_and_x):
+        step, x_t = t_and_x
+        state = carry
+        new_state, y = step_fn(state, x_t)
+        if lengths is not None:
+            pos = (t - 1 - step) if reverse else step
+            alive = (pos < lengths)
+
+            def mask(new, old):
+                m = alive.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+            new_state = jax.tree.map(mask, new_state, state)
+            y = jax.tree.map(lambda a: jnp.where(
+                alive.reshape((-1,) + (1,) * (a.ndim - 1)), a,
+                jnp.zeros_like(a)), y)
+        return new_state, y
+
+    xs = jax.tree.map(lambda a: jnp.moveaxis(a, 1, 0), inputs)  # [T, B, ...]
+    if reverse:
+        xs = jax.tree.map(lambda a: jnp.flip(a, 0), xs)
+    final, ys = lax.scan(scan_body, init_state,
+                         (jnp.arange(t), xs))
+    if reverse:
+        ys = jax.tree.map(lambda a: jnp.flip(a, 0), ys)
+    ys = jax.tree.map(lambda a: jnp.moveaxis(a, 0, 1), ys)      # [B, T, ...]
+    return ys, final
+
+
+def scan(f: Callable, init: Pytree, xs: Pytree, length: Optional[int] = None,
+         reverse: bool = False, unroll: int = 1):
+    """Thin re-export of lax.scan (the TPU-native loop primitive — one
+    trace of the body, compiler-pipelined; always prefer this over a
+    Python loop inside jit)."""
+    return lax.scan(f, init, xs, length=length, reverse=reverse,
+                    unroll=unroll)

@@ -1,0 +1,781 @@
+"""Detection op family — JAX-native, static-shape formulations.
+
+Capability-equivalent of /root/reference/paddle/fluid/operators/detection/
+(20+ ops). Where the reference emits variable-length LoD outputs (NMS,
+proposals), the TPU formulation returns fixed-size padded results plus a
+valid count/mask — the standard XLA idiom (same shape every step, so one
+compiled program serves every batch).
+
+Boxes are [x1, y1, x2, y2] unless noted; all ops are jit/vmap-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e9
+
+
+# ------------------------------------------------------------------- IoU
+
+def box_area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * \
+        jnp.maximum(boxes[..., 3] - boxes[..., 1], 0)
+
+
+def iou_similarity(x, y, box_normalized: bool = True):
+    """Pairwise IoU [N,4] x [M,4] -> [N,M] (iou_similarity_op.cc; the
+    non-normalized mode adds the reference's +1 pixel convention)."""
+    off = 0.0 if box_normalized else 1.0
+    x = x[:, None, :]
+    y = y[None, :, :]
+    ix1 = jnp.maximum(x[..., 0], y[..., 0])
+    iy1 = jnp.maximum(x[..., 1], y[..., 1])
+    ix2 = jnp.minimum(x[..., 2], y[..., 2])
+    iy2 = jnp.minimum(x[..., 3], y[..., 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0)
+    inter = iw * ih
+    ax = (x[..., 2] - x[..., 0] + off) * (x[..., 3] - x[..., 1] + off)
+    ay = (y[..., 2] - y[..., 0] + off) * (y[..., 3] - y[..., 1] + off)
+    union = ax + ay - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+# --------------------------------------------------------------- box coder
+
+def box_coder(prior_boxes, prior_var, target, code_type: str = "encode",
+              box_normalized: bool = True):
+    """Encode targets against priors / decode deltas (box_coder_op.cc).
+
+    encode: target [N,4] gt boxes, priors [M,4] -> [N,M,4] deltas.
+    decode: target [N,M,4] (or [N,4] with M==N priors) deltas -> boxes.
+    prior_var: [4] or [M,4] variances (None = ones).
+    """
+    off = 0.0 if box_normalized else 1.0
+    pw = prior_boxes[..., 2] - prior_boxes[..., 0] + off
+    ph = prior_boxes[..., 3] - prior_boxes[..., 1] + off
+    pcx = prior_boxes[..., 0] + pw * 0.5
+    pcy = prior_boxes[..., 1] + ph * 0.5
+    if prior_var is None:
+        v = jnp.ones((4,), jnp.float32)
+    else:
+        v = jnp.asarray(prior_var)
+
+    if code_type == "encode":
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None]) / pw[None]
+        dy = (tcy[:, None] - pcy[None]) / ph[None]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / v
+    if code_type == "decode":
+        d = target * v
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def box_clip(boxes, im_shape):
+    """Clip boxes into the image (box_clip_op.cc). im_shape = (h, w)."""
+    h, w = im_shape[0], im_shape[1]
+    x1 = jnp.clip(boxes[..., 0], 0, w - 1)
+    y1 = jnp.clip(boxes[..., 1], 0, h - 1)
+    x2 = jnp.clip(boxes[..., 2], 0, w - 1)
+    y2 = jnp.clip(boxes[..., 3], 0, h - 1)
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+def polygon_box_transform(x):
+    """Quad offsets -> absolute coords on the grid
+    (polygon_box_transform_op.cc): x [N, 8, H, W], even channels offset by
+    4*col, odd by 4*row."""
+    n, c, hh, ww = x.shape
+    col = jnp.arange(ww)[None, None, None, :] * 4.0
+    row = jnp.arange(hh)[None, None, :, None] * 4.0
+    even = jnp.arange(c) % 2 == 0
+    base = jnp.where(even[None, :, None, None], col, row)
+    return base - x
+
+
+# ---------------------------------------------------------------- priors
+
+def prior_box(feature_shape: Tuple[int, int], image_shape: Tuple[int, int],
+              min_sizes: Sequence[float],
+              max_sizes: Sequence[float] = (),
+              aspect_ratios: Sequence[float] = (1.0,),
+              variance: Sequence[float] = (0.1, 0.1, 0.2, 0.2),
+              flip: bool = True, clip: bool = False,
+              step: Tuple[float, float] = (0.0, 0.0),
+              offset: float = 0.5):
+    """SSD prior boxes (prior_box_op.cc). Returns (boxes [H,W,P,4],
+    variances [H,W,P,4]), normalized coords."""
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    sw = step[1] or iw / fw
+    sh = step[0] or ih / fh
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    for ms, xs in zip(min_sizes, max_sizes):
+        whs.append((np.sqrt(ms * xs), np.sqrt(ms * xs)))
+    wh = jnp.asarray(whs, jnp.float32)                   # [P, 2]
+
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)                      # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    hw = wh[None, None, :, 0] * 0.5
+    hh = wh[None, None, :, 1] * 0.5
+    boxes = jnp.stack([(cxg - hw) / iw, (cyg - hh) / ih,
+                       (cxg + hw) / iw, (cyg + hh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def density_prior_box(feature_shape, image_shape,
+                      fixed_sizes: Sequence[float],
+                      fixed_ratios: Sequence[float],
+                      densities: Sequence[int],
+                      variance=(0.1, 0.1, 0.2, 0.2),
+                      step=(0.0, 0.0), offset: float = 0.5,
+                      clip: bool = False):
+    """Density prior boxes (density_prior_box_op.cc): each fixed size is
+    sampled on a density x density sub-grid per cell."""
+    fh, fw = feature_shape
+    ih, iw = image_shape
+    sw = step[1] or iw / fw
+    sh = step[0] or ih / fh
+
+    # per-prior (shift_x, shift_y, w, h) templates within a cell
+    tmpl = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = sw / density  # reference uses step_average internally
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            for dx in range(density):
+                for dy in range(density):
+                    cx_off = (dx + 0.5) * shift - sw * 0.5
+                    cy_off = (dy + 0.5) * shift - sh * 0.5
+                    tmpl.append((cx_off, cy_off, bw, bh))
+    t = jnp.asarray(tmpl, jnp.float32)                   # [P, 4]
+
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[..., None] + t[None, None, :, 0]
+    ccy = cyg[..., None] + t[None, None, :, 1]
+    hw = t[None, None, :, 2] * 0.5
+    hh = t[None, None, :, 3] * 0.5
+    boxes = jnp.stack([(ccx - hw) / iw, (ccy - hh) / ih,
+                       (ccx + hw) / iw, (ccy + hh) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def anchor_generator(feature_shape, anchor_sizes: Sequence[float],
+                     aspect_ratios: Sequence[float],
+                     stride: Tuple[float, float],
+                     variance=(0.1, 0.1, 0.2, 0.2),
+                     offset: float = 0.5):
+    """RPN anchors in image coords (anchor_generator_op.cc). Returns
+    (anchors [H,W,A,4], variances)."""
+    fh, fw = feature_shape
+    sx, sy = stride
+    combos = []
+    for ar in aspect_ratios:
+        for sz in anchor_sizes:
+            area = sx * sy
+            w = np.sqrt(area / ar)
+            h = w * ar
+            # scale to requested size
+            w, h = w * sz / np.sqrt(area), h * sz / np.sqrt(area)
+            combos.append((w, h))
+    wh = jnp.asarray(combos, jnp.float32)
+    cx = (jnp.arange(fw) + offset) * sx
+    cy = (jnp.arange(fh) + offset) * sy
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    hw = wh[None, None, :, 0] * 0.5
+    hh = wh[None, None, :, 1] * 0.5
+    anchors = jnp.stack([cxg[..., None] - hw, cyg[..., None] - hh,
+                         cxg[..., None] + hw, cyg[..., None] + hh], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), anchors.shape)
+    return anchors, var
+
+
+# ------------------------------------------------------------------ match
+
+def bipartite_match(similarity):
+    """Greedy bipartite matching (bipartite_match_op.cc, default
+    'bipartite' type): repeatedly take the globally-largest entry, retire
+    its row and column. similarity [N, M] (rows = gt, cols = priors).
+    Returns (match_indices [M] int32 row-or--1, match_dist [M])."""
+    n, m = similarity.shape
+    k = min(n, m)
+
+    def body(carry, _):
+        sim, row_ok, col_ok = carry
+        masked = jnp.where(row_ok[:, None] & col_ok[None, :], sim, NEG_INF)
+        flat = jnp.argmax(masked)
+        r, c = flat // m, flat % m
+        best = masked[r, c]
+        valid = best > 0
+        row_ok = row_ok.at[r].set(jnp.where(valid, False, row_ok[r]))
+        col_ok = col_ok.at[c].set(jnp.where(valid, False, col_ok[c]))
+        return (sim, row_ok, col_ok), (r, c, best, valid)
+
+    (_, _, _), (rs, cs, bests, valids) = lax.scan(
+        body, (similarity, jnp.ones(n, bool), jnp.ones(m, bool)),
+        None, length=k)
+    match = jnp.full((m,), -1, jnp.int32)
+    dist = jnp.zeros((m,), similarity.dtype)
+    safe_c = jnp.where(valids, cs, 0)
+    match = match.at[safe_c].set(
+        jnp.where(valids, rs.astype(jnp.int32), match[safe_c]))
+    dist = dist.at[safe_c].set(jnp.where(valids, bests, dist[safe_c]))
+    return match, dist
+
+
+def target_assign(x, match_indices, mismatch_value=0):
+    """Gather per-prior targets by match index (target_assign_op.cc):
+    x [N, D] per-gt rows, match_indices [M] -> out [M, D], weight [M]."""
+    idx = jnp.maximum(match_indices, 0)
+    out = jnp.take(x, idx, axis=0)
+    w = (match_indices >= 0)
+    out = jnp.where(w[:, None], out, mismatch_value)
+    return out, w.astype(x.dtype)
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio: float = 3.0):
+    """OHEM negative selection (mine_hard_examples_op.cc, max_negative
+    mode): pick the top-loss negatives up to ratio * num_positives.
+    Returns a boolean mask over priors [M]."""
+    pos = match_indices >= 0
+    n_pos = jnp.sum(pos)
+    n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32),
+                        jnp.sum(~pos))
+    neg_loss = jnp.where(pos, NEG_INF, cls_loss)
+    order = jnp.argsort(-neg_loss)
+    rank = jnp.argsort(order)
+    return (~pos) & (rank < n_neg)
+
+
+# -------------------------------------------------------------------- NMS
+
+def nms(boxes, scores, iou_threshold: float = 0.3, max_output: int = 100,
+        score_threshold: float = -np.inf):
+    """Static-shape greedy NMS. Returns (indices [max_output] int32 padded
+    with -1, valid mask). The reference's multiclass_nms kernel does the
+    same greedy suppression with dynamic output (multiclass_nms_op.cc
+    NMSFast); the fixed-size masked result is the XLA formulation."""
+    s = jnp.where(scores > score_threshold, scores, NEG_INF)
+
+    def body(carry, _):
+        live = carry
+        best = jnp.argmax(live)
+        ok = live[best] > NEG_INF / 2
+        best_box = boxes[best]
+        iou = iou_similarity(best_box[None, :], boxes)[0]
+        suppress = iou > iou_threshold
+        live = jnp.where(suppress, NEG_INF, live)
+        live = live.at[best].set(NEG_INF)
+        return live, (jnp.where(ok, best, -1).astype(jnp.int32), ok)
+
+    _, (idx, ok) = lax.scan(body, s, None, length=max_output)
+    return idx, ok
+
+
+def multiclass_nms(boxes, scores, score_threshold: float = 0.01,
+                   nms_threshold: float = 0.3, nms_top_k: int = 64,
+                   keep_top_k: int = 100,
+                   background_label: int = 0):
+    """Per-class NMS + global top-k (multiclass_nms_op.cc).
+
+    boxes [N, 4]; scores [C, N]. Returns out [keep_top_k, 6]
+    (label, score, x1, y1, x2, y2) padded rows have label -1, plus the
+    valid count (the reference emits LoD'd variable rows; here fixed-size
+    + count)."""
+    c = scores.shape[0]
+
+    def per_class(cls_scores):
+        idx, ok = nms(boxes, cls_scores, nms_threshold, nms_top_k,
+                      score_threshold)
+        safe = jnp.maximum(idx, 0)
+        return (jnp.take(cls_scores, safe), jnp.take(boxes, safe, axis=0),
+                idx, ok)
+
+    cls_s, cls_b, cls_i, cls_ok = jax.vmap(per_class)(scores)
+    labels = jnp.broadcast_to(jnp.arange(c)[:, None], cls_s.shape)
+    is_bg = labels == background_label
+    flat_s = jnp.where(cls_ok & ~is_bg, cls_s, NEG_INF).reshape(-1)
+    flat_b = cls_b.reshape(-1, 4)
+    flat_l = labels.reshape(-1)
+
+    top_s, pick = lax.top_k(flat_s, keep_top_k)
+    valid = top_s > NEG_INF / 2
+    out = jnp.concatenate([
+        jnp.where(valid, flat_l[pick], -1)[:, None].astype(jnp.float32),
+        jnp.where(valid, top_s, 0)[:, None],
+        jnp.where(valid[:, None], flat_b[pick], 0),
+    ], axis=-1)
+    return out, jnp.sum(valid.astype(jnp.int32))
+
+
+# ------------------------------------------------------------------- RoI
+
+def roi_align(features, rois, output_size: Tuple[int, int],
+              spatial_scale: float = 1.0, sampling_ratio: int = 2):
+    """RoI Align (roi_align capability; detection/roi_* family +
+    bbox_util.h): features [H, W, C], rois [R, 4] in input coords.
+    Bilinear-samples an output_size grid with sampling_ratio^2 samples per
+    bin, averaged. Returns [R, ph, pw, C]."""
+    hh, ww, _ = features.shape
+    ph, pw = output_size
+    sr = max(sampling_ratio, 1)
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample centers: [ph, sr] x [pw, sr]
+        gy = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * bin_h
+        gx = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * bin_w
+        gy = gy.reshape(-1)          # [ph*sr]
+        gx = gx.reshape(-1)          # [pw*sr]
+
+        y0 = jnp.clip(jnp.floor(gy), 0, hh - 1)
+        x0 = jnp.clip(jnp.floor(gx), 0, ww - 1)
+        y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        wy = jnp.clip(gy - y0, 0.0, 1.0)
+        wx = jnp.clip(gx - x0, 0.0, 1.0)
+
+        f00 = features[y0i][:, x0i]      # [ph*sr, pw*sr, C]
+        f01 = features[y0i][:, x1i]
+        f10 = features[y1i][:, x0i]
+        f11 = features[y1i][:, x1i]
+        top = f00 * (1 - wx)[None, :, None] + f01 * wx[None, :, None]
+        bot = f10 * (1 - wx)[None, :, None] + f11 * wx[None, :, None]
+        val = top * (1 - wy)[:, None, None] + bot * wy[:, None, None]
+        val = val.reshape(ph, sr, pw, sr, -1).mean(axis=(1, 3))
+        return val
+
+    return jax.vmap(one_roi)(jnp.asarray(rois, jnp.float32))
+
+
+def roi_pool(features, rois, output_size: Tuple[int, int],
+             spatial_scale: float = 1.0):
+    """RoI max-pool via a dense sample grid (roi_pool capability): max of
+    roi_align-style samples per bin with a fine grid approximates the
+    reference's integer-bin max pool; exact for aligned integer rois."""
+    hh, ww, _ = features.shape
+    ph, pw = output_size
+    sr = 4
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        gy = y1 + (jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * (rh / ph) - 0.5
+        gx = x1 + (jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                   / sr) * (rw / pw) - 0.5
+        yi = jnp.clip(jnp.round(gy.reshape(-1)), 0, hh - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.round(gx.reshape(-1)), 0, ww - 1).astype(jnp.int32)
+        vals = features[yi][:, xi]                 # [ph*sr, pw*sr, C]
+        return vals.reshape(ph, sr, pw, sr, -1).max(axis=(1, 3))
+
+    return jax.vmap(one_roi)(jnp.asarray(rois, jnp.float32))
+
+
+# ------------------------------------------------------------- proposals
+
+def generate_proposals(scores, deltas, anchors, variances, im_shape,
+                       pre_nms_top_n: int = 6000,
+                       post_nms_top_n: int = 1000,
+                       nms_threshold: float = 0.7,
+                       min_size: float = 0.0):
+    """RPN proposal generation (generate_proposals_op.cc): top-k by score,
+    decode deltas against anchors, clip to image, filter small boxes, NMS.
+    scores [A], deltas [A, 4], anchors [A, 4]. Returns (rois
+    [post_nms_top_n, 4], roi_scores, valid mask)."""
+    k = min(pre_nms_top_n, scores.shape[0])
+    top_s, idx = lax.top_k(scores, k)
+    a = jnp.take(anchors, idx, axis=0)
+    v = jnp.take(variances, idx, axis=0) if variances is not None else None
+    d = jnp.take(deltas, idx, axis=0)
+    boxes = box_coder(a, v, d, code_type="decode")
+    boxes = box_clip(boxes, im_shape)
+    w = boxes[:, 2] - boxes[:, 0]
+    h = boxes[:, 3] - boxes[:, 1]
+    ok = (w >= min_size) & (h >= min_size)
+    s = jnp.where(ok, top_s, NEG_INF)
+    pick, valid = nms(boxes, s, nms_threshold, post_nms_top_n)
+    safe = jnp.maximum(pick, 0)
+    return (jnp.where(valid[:, None], jnp.take(boxes, safe, axis=0), 0),
+            jnp.where(valid, jnp.take(s, safe), 0), valid)
+
+
+# ------------------------------------------------- training target assignment
+
+def encode_boxes_paired(priors, targets, box_normalized: bool = False):
+    """Row-wise box encoding: priors [K, 4] vs targets [K, 4] -> [K, 4]
+    deltas (the diagonal of box_coder's pairwise encode)."""
+    off = 0.0 if box_normalized else 1.0
+    pw = priors[:, 2] - priors[:, 0] + off
+    ph = priors[:, 3] - priors[:, 1] + off
+    pcx = priors[:, 0] + pw * 0.5
+    pcy = priors[:, 1] + ph * 0.5
+    tw = targets[:, 2] - targets[:, 0] + off
+    th = targets[:, 3] - targets[:, 1] + off
+    tcx = targets[:, 0] + tw * 0.5
+    tcy = targets[:, 1] + th * 0.5
+    return jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                      jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                      jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+
+def rpn_target_assign(anchors, gt_boxes, gt_valid, rng,
+                      num_samples: int = 256, fg_fraction: float = 0.5,
+                      positive_overlap: float = 0.7,
+                      negative_overlap: float = 0.3):
+    """RPN anchor labeling + subsampling (rpn_target_assign_op.cc).
+
+    anchors [A, 4]; gt_boxes [G, 4]; gt_valid [G] bool (padded gt rows
+    False). Returns (labels [A] int32: 1 fg / 0 bg / -1 ignore,
+    bbox_targets [A, 4] encoded deltas, inside_weights [A] = fg mask).
+
+    Anchors with IoU > positive_overlap (or the best anchor per gt) are
+    fg; IoU < negative_overlap bg; rest ignored. Random subsampling to
+    `num_samples` with `fg_fraction` fg uses rng-ranked selection — the
+    XLA-friendly analog of the reference's shuffle-and-truncate.
+    """
+    a = anchors.shape[0]
+    iou = iou_similarity(gt_boxes, anchors, box_normalized=False)  # [G, A]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=0)                 # [A]
+    best_iou = jnp.max(iou, axis=0)                   # [A]
+    # the best anchor for each (valid) gt is always fg; .max (not .set)
+    # so a padded gt row (argmax 0 on its zeroed IoU row) can never clear
+    # a valid gt's forced anchor
+    best_anchor = jnp.argmax(iou, axis=1)             # [G]
+    forced = jnp.zeros((a,), bool).at[best_anchor].max(gt_valid)
+    fg = forced | (best_iou >= positive_overlap)
+    bg = (~fg) & (best_iou < negative_overlap)
+
+    # rng-ranked subsampling: rank fg (resp. bg) candidates by random key,
+    # keep the first n_fg (resp. n_bg)
+    n_fg = jnp.minimum(int(num_samples * fg_fraction),
+                       jnp.sum(fg)).astype(jnp.int32)
+    r = jax.random.uniform(rng, (a,))
+    fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+    fg_keep = fg & (fg_rank < n_fg)
+    n_bg = jnp.minimum(num_samples - n_fg, jnp.sum(bg)).astype(jnp.int32)
+    bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+    bg_keep = bg & (bg_rank < n_bg)
+
+    labels = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1)).astype(
+        jnp.int32)
+    matched = jnp.take(gt_boxes, best_gt, axis=0)     # [A, 4]
+    targets = encode_boxes_paired(anchors, matched)
+    targets = jnp.where(fg_keep[:, None], targets, 0.0)
+    return labels, targets, fg_keep.astype(jnp.float32)
+
+
+def generate_proposal_labels(rois, gt_boxes, gt_classes, gt_valid, rng,
+                             batch_size_per_im: int = 128,
+                             fg_fraction: float = 0.25,
+                             fg_thresh: float = 0.5,
+                             bg_thresh_hi: float = 0.5,
+                             bg_thresh_lo: float = 0.0):
+    """Sample RoIs + assign classification/regression targets for the
+    second stage (generate_proposal_labels_op.cc).
+
+    rois [R, 4]; gt_boxes [G, 4]; gt_classes [G] int; gt_valid [G] bool.
+    Returns fixed-size (sampled_rois [S, 4], labels [S] int32 (0 = bg, -1 =
+    pad), bbox_targets [S, 4], fg_mask [S] float) with S = batch_size_per_im.
+    """
+    iou = iou_similarity(gt_boxes, rois, box_normalized=False)   # [G, R]
+    iou = jnp.where(gt_valid[:, None], iou, 0.0)
+    best_gt = jnp.argmax(iou, axis=0)
+    best_iou = jnp.max(iou, axis=0)
+    fg = best_iou >= fg_thresh
+    bg = (best_iou < bg_thresh_hi) & (best_iou >= bg_thresh_lo) & (~fg)
+
+    s = batch_size_per_im
+    n_fg = jnp.minimum(int(s * fg_fraction), jnp.sum(fg)).astype(jnp.int32)
+    r = jax.random.uniform(rng, (rois.shape[0],))
+    fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, r, 2.0)))
+    bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, r, 2.0)))
+    n_bg = jnp.minimum(s - n_fg, jnp.sum(bg)).astype(jnp.int32)
+    keep = (fg & (fg_rank < n_fg)) | (bg & (bg_rank < n_bg))
+    # order selected rois first (fg then bg), pad with zeros
+    sel_key = jnp.where(fg & (fg_rank < n_fg), fg_rank,
+                        jnp.where(bg & (bg_rank < n_bg),
+                                  s + bg_rank, 2 * s + 1e6))
+    order = jnp.argsort(sel_key)[:s]
+    sel_valid = jnp.take(keep, order)
+    out_rois = jnp.where(sel_valid[:, None],
+                         jnp.take(rois, order, axis=0), 0.0)
+    sel_fg = jnp.take(fg, order) & sel_valid
+    cls = jnp.take(jnp.take(gt_classes, best_gt), order)
+    labels = jnp.where(sel_fg, cls.astype(jnp.int32),
+                       jnp.where(sel_valid, 0, -1))
+    matched = jnp.take(jnp.take(gt_boxes, best_gt, axis=0), order, axis=0)
+    targets = encode_boxes_paired(out_rois, matched)
+    targets = jnp.where(sel_fg[:, None], targets, 0.0)
+    return out_rois, labels, targets, sel_fg.astype(jnp.float32)
+
+
+def generate_mask_labels(rois, fg_mask, roi_gt_index, gt_masks,
+                         resolution: int = 14):
+    """Crop+resize each fg RoI's matched instance mask to a fixed
+    [resolution, resolution] training target (generate_mask_labels_op.cc).
+
+    rois [S, 4]; fg_mask [S]; roi_gt_index [S] int (matched gt per roi);
+    gt_masks [G, Hm, Wm] float in image coords. Returns [S, res, res].
+    """
+    hm, wm = gt_masks.shape[1:]
+
+    def one(roi, gi, is_fg):
+        m = jnp.take(gt_masks, gi, axis=0)            # [Hm, Wm]
+        x1, y1, x2, y2 = roi
+        gy = y1 + (jnp.arange(resolution) + 0.5) / resolution * \
+            jnp.maximum(y2 - y1, 1.0)
+        gx = x1 + (jnp.arange(resolution) + 0.5) / resolution * \
+            jnp.maximum(x2 - x1, 1.0)
+        yi = jnp.clip(jnp.round(gy), 0, hm - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.round(gx), 0, wm - 1).astype(jnp.int32)
+        patch = m[yi][:, xi]
+        return jnp.where(is_fg, (patch > 0.5).astype(jnp.float32), 0.0)
+
+    return jax.vmap(one)(jnp.asarray(rois, jnp.float32),
+                         roi_gt_index.astype(jnp.int32), fg_mask > 0)
+
+
+# ------------------------------------------------------- RoI (tail variants)
+
+def psroi_pool(features, rois, output_size: Tuple[int, int],
+               spatial_scale: float = 1.0, sampling_ratio: int = 2):
+    """Position-sensitive RoI pooling (psroi_pool_op.cc): input channels
+    C = ph*pw*out_c; bin (i, j) average-pools only its own channel group.
+    features [H, W, ph*pw*out_c]; rois [R, 4] -> [R, ph, pw, out_c].
+
+    Samples each bin's own channel slice directly (sampling all ph*pw
+    groups and discarding all but one would do ph*pw times the work)."""
+    hh, ww, c = features.shape
+    ph, pw = output_size
+    out_c = c // (ph * pw)
+    sr = max(sampling_ratio, 1)
+    grouped = features.reshape(hh, ww, ph * pw, out_c)
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        bin_w = jnp.maximum(x2 - x1, 1.0) / pw
+        bin_h = jnp.maximum(y2 - y1, 1.0) / ph
+        # sample grid per bin: [ph, sr] x [pw, sr]
+        gy = y1 + (jnp.arange(ph)[:, None]
+                   + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_h
+        gx = x1 + (jnp.arange(pw)[:, None]
+                   + (jnp.arange(sr)[None, :] + 0.5) / sr) * bin_w
+        y0 = jnp.clip(jnp.floor(gy), 0, hh - 1)                    # [ph,sr]
+        x0 = jnp.clip(jnp.floor(gx), 0, ww - 1)                    # [pw,sr]
+        y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+        y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+        wy = jnp.clip(gy - y0, 0.0, 1.0)[:, None, :, None, None]
+        wx = jnp.clip(gx - x0, 0.0, 1.0)[None, :, None, :, None]
+        # gather only bin (i, j)'s channel group g = i*pw + j
+        bin_g = (jnp.arange(ph)[:, None] * pw
+                 + jnp.arange(pw)[None, :])[:, :, None, None]      # [ph,pw]
+
+        def g(yi, xi):   # -> [ph, pw, sr, sr, out_c]
+            return grouped[yi[:, None, :, None], xi[None, :, None, :],
+                           bin_g]
+        top = g(y0i, x0i) * (1 - wx) + g(y0i, x1i) * wx
+        bot = g(y1i, x0i) * (1 - wx) + g(y1i, x1i) * wx
+        vals = top * (1 - wy) + bot * wy
+        return vals.mean(axis=(2, 3))
+
+    return jax.vmap(one_roi)(jnp.asarray(rois, jnp.float32))
+
+
+def roi_perspective_transform(features, quads, out_size: Tuple[int, int],
+                              spatial_scale: float = 1.0):
+    """Perspective-warp quadrilateral RoIs to a fixed rectangle
+    (roi_perspective_transform_op.cc — used by OCR pipelines).
+
+    features [H, W, C]; quads [R, 8] = (x1,y1,...,x4,y4) clockwise from
+    top-left, in input coords. Computes the 3x3 homography mapping the
+    output rectangle onto each quad and bilinear-samples. -> [R, oh, ow, C].
+    """
+    hh, ww, _ = features.shape
+    oh, ow = out_size
+
+    def homography(quad):
+        # solve H (8 dof) s.t. H @ [u, v, 1] ~ quad corners, for the four
+        # output-rect corners (0,0), (ow-1,0), (ow-1,oh-1), (0,oh-1)
+        src = jnp.array([[0.0, 0.0], [ow - 1.0, 0.0],
+                         [ow - 1.0, oh - 1.0], [0.0, oh - 1.0]])
+        dst = quad.reshape(4, 2) * spatial_scale
+        rows = []
+        for i in range(4):
+            u, v = src[i, 0], src[i, 1]
+            x, y = dst[i, 0], dst[i, 1]
+            rows.append(jnp.array([u, v, 1.0, 0, 0, 0]).tolist()
+                        + [-u * x, -v * x])
+            rows.append(jnp.array([0, 0, 0.0, u, v, 1.0]).tolist()
+                        + [-u * y, -v * y])
+        amat = jnp.stack([jnp.stack([jnp.asarray(e, jnp.float32)
+                                     for e in row]) for row in rows])
+        bvec = dst.reshape(-1)
+        h8 = jnp.linalg.solve(amat, bvec)
+        return jnp.concatenate([h8, jnp.ones((1,))]).reshape(3, 3)
+
+    def one(quad):
+        hmat = homography(quad)
+        u = jnp.arange(ow, dtype=jnp.float32)
+        v = jnp.arange(oh, dtype=jnp.float32)
+        uu, vv = jnp.meshgrid(u, v)                   # [oh, ow]
+        ones = jnp.ones_like(uu)
+        pts = jnp.stack([uu, vv, ones], axis=-1) @ hmat.T   # [oh, ow, 3]
+        gx = pts[..., 0] / jnp.maximum(pts[..., 2], 1e-8)
+        gy = pts[..., 1] / jnp.maximum(pts[..., 2], 1e-8)
+        x0 = jnp.clip(jnp.floor(gx), 0, ww - 1)
+        y0 = jnp.clip(jnp.floor(gy), 0, hh - 1)
+        x1i = jnp.clip(x0 + 1, 0, ww - 1).astype(jnp.int32)
+        y1i = jnp.clip(y0 + 1, 0, hh - 1).astype(jnp.int32)
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        wx = jnp.clip(gx - x0, 0, 1)[..., None]
+        wy = jnp.clip(gy - y0, 0, 1)[..., None]
+        f00 = features[y0i, x0i]
+        f01 = features[y0i, x1i]
+        f10 = features[y1i, x0i]
+        f11 = features[y1i, x1i]
+        val = ((f00 * (1 - wx) + f01 * wx) * (1 - wy)
+               + (f10 * (1 - wx) + f11 * wx) * wy)
+        inside = ((gx >= 0) & (gx <= ww - 1) & (gy >= 0)
+                  & (gy <= hh - 1))[..., None]
+        return jnp.where(inside, val, 0.0)
+
+    return jax.vmap(one)(jnp.asarray(quads, jnp.float32))
+
+
+# ---------------------------------------------------------------- YOLO loss
+
+def yolov3_loss(preds, gt_boxes, gt_labels, gt_valid, anchors,
+                num_classes: int, downsample: int = 32,
+                ignore_thresh: float = 0.7):
+    """YOLOv3 training loss (yolov3_loss_op.cc), single scale.
+
+    preds: [H, W, A*(5+num_classes)] raw head output (NHWC); anchors:
+    [A, 2] (w, h) in pixels; gt_boxes [G, 4] (cx, cy, w, h) normalized to
+    [0,1]; gt_labels [G] int; gt_valid [G] bool. Returns scalar loss:
+    bce(objectness) + bce(class) + l1(box) over responsible cells, with
+    non-responsible high-IoU predictions ignored, as in the reference.
+    """
+    h, w, _ = preds.shape
+    a = anchors.shape[0]
+    p = preds.reshape(h, w, a, 5 + num_classes)
+    tx, ty = p[..., 0], p[..., 1]
+    tw, th = p[..., 2], p[..., 3]
+    tobj = p[..., 4]
+    tcls = p[..., 5:]
+
+    img_w, img_h = w * downsample, h * downsample
+    anchors = jnp.asarray(anchors, jnp.float32)
+
+    # decode predictions to normalized boxes for the ignore-mask IoU test
+    gx = (jax.nn.sigmoid(tx) + jnp.arange(w)[None, :, None]) / w
+    gy = (jax.nn.sigmoid(ty) + jnp.arange(h)[:, None, None]) / h
+    gw = jnp.exp(jnp.clip(tw, -10, 10)) * anchors[None, None, :, 0] / img_w
+    gh = jnp.exp(jnp.clip(th, -10, 10)) * anchors[None, None, :, 1] / img_h
+    pred_boxes = jnp.stack([gx - gw / 2, gy - gh / 2,
+                            gx + gw / 2, gy + gh / 2], axis=-1)
+
+    gxyxy = jnp.stack([gt_boxes[:, 0] - gt_boxes[:, 2] / 2,
+                       gt_boxes[:, 1] - gt_boxes[:, 3] / 2,
+                       gt_boxes[:, 0] + gt_boxes[:, 2] / 2,
+                       gt_boxes[:, 1] + gt_boxes[:, 3] / 2], axis=-1)
+    iou_all = iou_similarity(gxyxy, pred_boxes.reshape(-1, 4))  # [G, HWA]
+    iou_all = jnp.where(gt_valid[:, None], iou_all, 0.0)
+    best_iou = jnp.max(iou_all, axis=0).reshape(h, w, a)
+    ignore = best_iou > ignore_thresh
+
+    # responsibility: per gt, the anchor with best shape-IoU at its cell
+    def per_gt(box, label, valid):
+        cx, cy, bw, bh = box
+        ci = jnp.clip((cx * w).astype(jnp.int32), 0, w - 1)
+        cj = jnp.clip((cy * h).astype(jnp.int32), 0, h - 1)
+        # shape-only IoU vs anchors
+        aw, ah = anchors[:, 0] / img_w, anchors[:, 1] / img_h
+        inter = jnp.minimum(bw, aw) * jnp.minimum(bh, ah)
+        union = bw * bh + aw * ah - inter
+        best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9))
+        # targets
+        ttx = cx * w - ci
+        tty = cy * h - cj
+        ttw = jnp.log(jnp.maximum(bw * img_w, 1e-9)
+                      / anchors[best_a, 0])
+        tth = jnp.log(jnp.maximum(bh * img_h, 1e-9)
+                      / anchors[best_a, 1])
+        onehot = jax.nn.one_hot(label, num_classes)
+        scale = 2.0 - bw * bh      # small boxes weighted up (reference)
+        return cj, ci, best_a, jnp.array([ttx, tty, ttw, tth]), onehot, \
+            scale, valid
+
+    cj, ci, ba, tgt, onehot, scale, valid = jax.vmap(per_gt)(
+        gt_boxes, gt_labels, gt_valid)
+
+    obj_target = jnp.zeros((h, w, a))
+    obj_target = obj_target.at[cj, ci, ba].max(valid.astype(jnp.float32))
+    # ignore mask: no obj loss where a non-responsible pred overlaps a gt
+    noobj_w = jnp.where(ignore & (obj_target < 0.5), 0.0, 1.0)
+
+    bce = lambda logit, t: jnp.maximum(logit, 0) - logit * t + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    obj_loss = jnp.sum(bce(tobj, obj_target) * noobj_w)
+
+    def gt_losses(cj_i, ci_i, ba_i, tgt_i, oh_i, sc_i, valid_i):
+        px = jnp.array([jax.nn.sigmoid(tx[cj_i, ci_i, ba_i]),
+                        jax.nn.sigmoid(ty[cj_i, ci_i, ba_i]),
+                        tw[cj_i, ci_i, ba_i], th[cj_i, ci_i, ba_i]])
+        box_l = jnp.sum(jnp.abs(px - tgt_i)) * sc_i
+        cls_l = jnp.sum(bce(tcls[cj_i, ci_i, ba_i], oh_i))
+        return jnp.where(valid_i, box_l + cls_l, 0.0)
+
+    per_gt_loss = jax.vmap(gt_losses)(cj, ci, ba, tgt, onehot, scale, valid)
+    return obj_loss + jnp.sum(per_gt_loss)

@@ -1,0 +1,495 @@
+"""Long-tail op library: vision warps, sampling, losses, tensor utilities.
+
+Capability-equivalent of the remaining reference op families in
+/root/reference/paddle/fluid/operators/ not covered by functional.py,
+sequence.py, detection.py or lattice.py: grid_sampler, affine_grid,
+affine_channel, shuffle_channel, space_to_depth, pixel unpool,
+pool-with-index, spp, im2sequence, prelu, selu, row_conv, conv_shift,
+bilinear_tensor_product, add_position_encoding, multiplex, rank_loss,
+bpr_loss, teacher_student_sigmoid_loss, modified_huber_loss, npair/center
+capability, mean_iou, sampling_id, random ops, hash, similarity_focus,
+crop, pad2d, unstack, shape/fill/cast utilities.
+
+All jit-safe, NHWC layout for image ops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+# ------------------------------------------------------------ vision warps
+
+def affine_grid(theta, out_shape: Tuple[int, int]):
+    """Sampling grid from 2x3 affine matrices (affine_grid op).
+    theta [B, 2, 3] -> grid [B, H, W, 2] in [-1, 1] coords."""
+    h, w = out_shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    xg, yg = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)          # [H, W, 3]
+    return jnp.einsum("hwk,bjk->bhwj", base, theta)    # [B, H, W, 2]
+
+
+def grid_sampler(x, grid):
+    """Bilinear sampling of x [B, H, W, C] at grid [B, Hg, Wg, 2]
+    ([-1,1] xy coords; zeros outside — grid_sampler op semantics)."""
+    b, h, w, c = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        vals = jax.vmap(lambda img, yy, xx: img[yy, xx])(x, yc, xc)
+        return jnp.where(inside[..., None], vals, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x1)
+    v10 = gather(y1, x0)
+    v11 = gather(y1, x1)
+    top = v00 * (1 - wx)[..., None] + v01 * wx[..., None]
+    bot = v10 * (1 - wx)[..., None] + v11 * wx[..., None]
+    return top * (1 - wy)[..., None] + bot * wy[..., None]
+
+
+def affine_channel(x, scale, bias):
+    """Per-channel y = x * scale + bias (affine_channel op; frozen-BN
+    form). x [..., C], scale/bias [C]."""
+    return x * scale + bias
+
+
+def shuffle_channel(x, groups: int):
+    """Channel shuffle (shuffle_channel op; ShuffleNet). NHWC."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    return jnp.swapaxes(x, 3, 4).reshape(n, h, w, c)
+
+
+def space_to_depth(x, block: int):
+    """NHWC space->depth rearrange (space_to_depth op)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, block * block * c)
+
+
+def depth_to_space(x, block: int):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, block, block, c // (block * block))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * block, w * block, c // (block * block))
+
+
+def max_pool2d_with_index(x, kernel: int, stride: int):
+    """Max pool returning flat argmax indices per window
+    (pool_with_index op). x [B, H, W, C] -> (out, idx) with idx = flat
+    h*W+w position of each max."""
+    b, h, w, c = x.shape
+    pos = (jnp.arange(h)[:, None] * w
+           + jnp.arange(w)[None, :]).astype(jnp.float32)
+    pos = jnp.broadcast_to(pos[None, :, :, None], x.shape)
+    init = (-jnp.inf, 0.0)
+
+    def reducer(a, b_):
+        av, ai = a
+        bv, bi = b_
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = lax.reduce_window(
+        (x, pos), init, reducer,
+        window_dimensions=(1, kernel, kernel, 1),
+        window_strides=(1, stride, stride, 1), padding="VALID")
+    return out, idx.astype(jnp.int32)
+
+
+def max_pool3d_with_index(x, kernel: int, stride: int):
+    """3-D max pool returning flat argmax indices per window
+    (max_pool3d_with_index op, operators/pool_with_index_op.cc). x
+    [B, D, H, W, C] -> (out, idx) with idx = flat d*H*W + h*W + w."""
+    b, d, h, w, c = x.shape
+    pos = (jnp.arange(d)[:, None, None] * (h * w)
+           + jnp.arange(h)[None, :, None] * w
+           + jnp.arange(w)[None, None, :]).astype(jnp.float32)
+    pos = jnp.broadcast_to(pos[None, :, :, :, None], x.shape)
+    init = (-jnp.inf, 0.0)
+
+    def reducer(a, b_):
+        av, ai = a
+        bv, bi = b_
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = lax.reduce_window(
+        (x, pos), init, reducer,
+        window_dimensions=(1, kernel, kernel, kernel, 1),
+        window_strides=(1, stride, stride, stride, 1), padding="VALID")
+    return out, idx.astype(jnp.int32)
+
+
+def max_unpool2d(y, idx, out_hw: Tuple[int, int]):
+    """Scatter pooled values back to their argmax positions (unpool op).
+    y/idx [B, Hp, Wp, C] -> [B, H, W, C]."""
+    b, hp, wp, c = y.shape
+    h, w = out_hw
+    flat = jnp.zeros((b, h * w, c), y.dtype)
+    idx2 = idx.reshape(b, hp * wp, c)
+    val2 = y.reshape(b, hp * wp, c)
+    bi = jnp.arange(b)[:, None, None]
+    ci = jnp.arange(c)[None, None, :]
+    flat = flat.at[bi, idx2, ci].add(val2)
+    return flat.reshape(b, h, w, c)
+
+
+def spp(x, levels: Sequence[int] = (1, 2, 4), pool_type: str = "max"):
+    """Spatial pyramid pooling (spp op): concat pooled features at several
+    grid resolutions. x [B, H, W, C] -> [B, sum(l*l)*C]."""
+    b, h, w, c = x.shape
+    outs = []
+    for lvl in levels:
+        ph = h // lvl
+        pw = w // lvl
+        xc = x[:, :ph * lvl, :pw * lvl]
+        xr = xc.reshape(b, lvl, ph, lvl, pw, c)
+        pooled = (jnp.max(xr, axis=(2, 4)) if pool_type == "max"
+                  else jnp.mean(xr, axis=(2, 4)))
+        outs.append(pooled.reshape(b, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+def im2sequence(x, kernel: Tuple[int, int], stride: Tuple[int, int]):
+    """Image -> patch sequence (im2sequence op, OCR pipelines):
+    [B, H, W, C] -> [B, N_patches, kh*kw*C] in raster order."""
+    kh, kw = kernel
+    sh, sw = stride
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    b, oh, ow, d = patches.shape
+    return patches.reshape(b, oh * ow, d)
+
+
+def random_crop_op(rng, x, crop_shape: Tuple[int, ...]):
+    """Random crop (random_crop op): same offsets across the batch dims
+    not cropped. x [..., *dims]; crop_shape applies to trailing dims."""
+    nd = len(crop_shape)
+    starts = []
+    for i, cs in enumerate(crop_shape):
+        dim = x.shape[x.ndim - nd + i]
+        rng, sub = jax.random.split(rng)
+        starts.append(jax.random.randint(sub, (), 0, dim - cs + 1))
+    idx = (slice(None),) * (x.ndim - nd)
+    return lax.dynamic_slice(
+        x, [0] * (x.ndim - nd) + [s for s in starts],
+        list(x.shape[:x.ndim - nd]) + list(crop_shape))
+
+
+def similarity_focus(x, axis: int, indexes: Sequence[int]):
+    """similarity_focus op: build a 0/1 focus mask — for each selected
+    channel, mark the max position per (row, col) of the remaining dims.
+    x [B, H, W, C] (axis=3 selects channels)."""
+    if axis != 3:
+        raise NotImplementedError("NHWC channel focus only")
+    b, h, w, c = x.shape
+    mask = jnp.zeros_like(x)
+    for ch in indexes:
+        plane = x[..., ch]                               # [B, H, W]
+        row_max = plane == jnp.max(plane, axis=2, keepdims=True)
+        col_max = plane == jnp.max(plane, axis=1, keepdims=True)
+        focus = (row_max | col_max).astype(x.dtype)
+        mask = mask.at[..., ch].set(focus)
+    return mask
+
+
+# ----------------------------------------------------------- param'd ops
+
+def prelu(x, alpha):
+    """prelu op: alpha scalar, per-channel [C], or elementwise."""
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def selu(x, scale: float = 1.0507009873554805,
+         alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x >= 0, x, alpha * (jnp.exp(x) - 1.0))
+
+
+def row_conv(x, weight):
+    """Lookahead row convolution (row_conv op, Deep Speech):
+    x [B, T, D], weight [future_context+1, D]; y[t] = sum_k w[k]*x[t+k]."""
+    ctx = weight.shape[0]
+    t = x.shape[1]
+    pad = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for k in range(ctx):
+        out = out + pad[:, k:k + t] * weight[k][None, None, :]
+    return out
+
+
+def conv_shift(x, y):
+    """Circular correlation (conv_shift op): x [B, M], y [B, N] (N odd,
+    N<=M); out[i] = sum_j y[j] * x[(i + j - N//2) mod M]."""
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    outs = []
+    for j in range(n):
+        outs.append(jnp.roll(x, half - j, axis=1) * y[:, j:j + 1])
+    return sum(outs)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """out[:, k] = x W_k y^T (bilinear_tensor_product op).
+    x [B, M], y [B, N], weight [K, M, N]."""
+    out = jnp.einsum("bm,kmn,bn->bk", x, weight, y)
+    return out + bias if bias is not None else out
+
+
+def add_position_encoding(x, alpha: float = 1.0, beta: float = 1.0):
+    """Sinusoid position encoding added in-place (add_position_encoding
+    op): y = alpha * x + beta * pe. x [B, T, D]."""
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    return alpha * x + beta * pe[None, :, :d].astype(x.dtype)
+
+
+def multiplex(index, inputs):
+    """Row-wise select among candidate tensors (multiplex op):
+    inputs list of [B, D], index [B] -> out[b] = inputs[index[b]][b]."""
+    stacked = jnp.stack(inputs, axis=0)                # [N, B, D]
+    return jnp.take_along_axis(
+        stacked, index[None, :, None].astype(jnp.int32), axis=0)[0]
+
+
+# ----------------------------------------------------------------- losses
+
+def rank_loss(left, right, label):
+    """RankNet pairwise loss (rank_loss op): label 1 if left should rank
+    higher."""
+    diff = left - right
+    return jnp.log1p(jnp.exp(diff)) - label * diff
+
+
+def bpr_loss(logits, label):
+    """Bayesian personalized ranking loss (bpr_loss op): -mean log
+    sigmoid(score[label] - score[j]) over negatives j."""
+    pos = jnp.take_along_axis(logits, label[:, None].astype(jnp.int32),
+                              axis=1)
+    diff = pos - logits
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-8)
+    n = logits.shape[1]
+    mask = jnp.ones_like(loss).at[
+        jnp.arange(label.shape[0]), label.astype(jnp.int32)].set(0.0)
+    return jnp.sum(loss * mask, axis=1) / (n - 1)
+
+
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound: float = 15.0,
+                                 soft_max_lower_bound: float = -15.0):
+    """teacher_student_sigmoid_loss op: CTR distillation loss — hard
+    sigmoid CE for the click part + soft teacher-score part."""
+    z = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    # label < -1: only soft part (teacher score = label + 2); binary else
+    teacher = label + 2.0
+    hard = jnp.maximum(z, 0) - z * jnp.minimum(label, 1.0) \
+        + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    soft = jnp.maximum(z, 0) - z * (teacher - jnp.floor(teacher)) \
+        + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    return jnp.where(label < -1.0, soft, hard)
+
+
+def modified_huber_loss(x, y):
+    """modified_huber_loss op: y in {0,1} -> {-1,1}; quadratic inside
+    margin, linear outside."""
+    yy = 2.0 * y - 1.0
+    z = x * yy
+    return jnp.where(z >= 1.0, 0.0,
+                     jnp.where(z >= -1.0, jnp.square(1.0 - z), -4.0 * z))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """N-pair metric learning loss (npair_loss capability)."""
+    sim = anchor @ positive.T                          # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    same = same / jnp.sum(same, axis=1, keepdims=True)
+    xent = -jnp.sum(same * jax.nn.log_softmax(sim, axis=1), axis=1)
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor ** 2, 1))
+                    + jnp.mean(jnp.sum(positive ** 2, 1)))
+    return jnp.mean(xent) + reg
+
+
+def center_loss(features, labels, centers, alpha: float = 0.5):
+    """center_loss capability: pull features to class centers. Returns
+    (loss [B], updated centers)."""
+    c = jnp.take(centers, labels, axis=0)
+    loss = 0.5 * jnp.sum(jnp.square(features - c), axis=1)
+    diff = c - features
+    counts = jax.ops.segment_sum(jnp.ones_like(labels, jnp.float32),
+                                 labels, num_segments=centers.shape[0])
+    delta = jax.ops.segment_sum(diff, labels,
+                                num_segments=centers.shape[0])
+    new_centers = centers - alpha * delta / (counts[:, None] + 1.0)
+    return loss, new_centers
+
+
+def mean_iou(pred, label, num_classes: int):
+    """mean_iou op: mean intersection-over-union over classes present."""
+    pred = pred.reshape(-1).astype(jnp.int32)
+    label = label.reshape(-1).astype(jnp.int32)
+    idx = label * num_classes + pred
+    cm = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx].add(1.0)
+    cm = cm.reshape(num_classes, num_classes)
+    inter = jnp.diag(cm)
+    union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    return jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+
+
+# --------------------------------------------------------------- sampling
+
+def sampling_id(rng, probs):
+    """Sample one id per row from probability rows (sampling_id op)."""
+    return jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-20)),
+                                  axis=-1)
+
+
+def uniform_random(rng, shape, minval=-1.0, maxval=1.0, dtype=jnp.float32):
+    return jax.random.uniform(rng, shape, dtype, minval, maxval)
+
+
+def gaussian_random(rng, shape, mean=0.0, std=1.0, dtype=jnp.float32):
+    return mean + std * jax.random.normal(rng, shape, dtype)
+
+
+def truncated_gaussian_random(rng, shape, mean=0.0, std=1.0,
+                              dtype=jnp.float32):
+    """truncated_gaussian_random op: resample outside 2 std (via
+    truncated_normal)."""
+    return mean + std * jax.random.truncated_normal(rng, -2.0, 2.0, shape,
+                                                    dtype)
+
+
+def hash_embedding_ids(ids, mod: int, num_hash: int = 1):
+    """hash op capability: map sparse ids into a bounded table with
+    `num_hash` independent hashes (multiplicative hashing; the reference
+    uses xxhash). ids [...] -> [..., num_hash] int32 in [0, mod)."""
+    primes = np.array([2654435761, 2246822519, 3266489917, 668265263,
+                       374761393], np.uint32)
+    h = []
+    ids = ids.astype(jnp.uint32)
+    for k in range(num_hash):
+        p = jnp.uint32(primes[k % len(primes)])
+        v = (ids * p + jnp.uint32(k * 0x9E3779B9)) % jnp.uint32(mod)
+        h.append(v.astype(jnp.int32))
+    return jnp.stack(h, axis=-1)
+
+
+# ----------------------------------------------------------- tensor utils
+
+def crop(x, offsets: Sequence[int], shape: Sequence[int]):
+    """crop op: static offset slice."""
+    return lax.slice(x, offsets,
+                     [o + s for o, s in zip(offsets, shape)])
+
+
+def pad2d(x, paddings: Sequence[int], mode: str = "constant",
+          value: float = 0.0):
+    """pad2d op: NHWC spatial padding [top, bottom, left, right];
+    constant/reflect/edge modes."""
+    t, b_, l, r = paddings
+    cfg = ((0, 0), (t, b_), (l, r), (0, 0))
+    if mode == "constant":
+        return jnp.pad(x, cfg, constant_values=value)
+    return jnp.pad(x, cfg, mode="reflect" if mode == "reflect" else "edge")
+
+
+def pad_constant_like(x, y, value: float = 0.0):
+    """pad_constant_like op: pad y up to x's shape."""
+    cfg = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, cfg, constant_values=value)
+
+
+def unstack(x, axis: int = 0):
+    return [jnp.squeeze(s, axis) for s in
+            jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def flatten(x, axis: int = 1):
+    """flatten op: collapse dims before/after `axis` into a matrix."""
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return x.reshape(lead, -1)
+
+
+def increment(x, value: float = 1.0):
+    return x + value
+
+
+def fill_constant_batch_size_like(ref, shape, value, dtype=jnp.float32,
+                                  batch_dim: int = 0):
+    """fill_constant_batch_size_like op: shape[batch_dim] taken from ref."""
+    shape = list(shape)
+    shape[batch_dim] = ref.shape[batch_dim]
+    return jnp.full(shape, value, dtype)
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def positive_negative_pair(scores, labels, query_ids):
+    """positive_negative_pair op (ranking metric): counts concordant /
+    discordant score pairs within each query group. Returns (pos, neg,
+    neutral) counts."""
+    same_q = query_ids[:, None] == query_ids[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q, dtype=bool), k=1)
+    pair = same_q & upper & (labels[:, None] != labels[None, :])
+    s_diff = scores[:, None] - scores[None, :]
+    l_diff = labels[:, None] - labels[None, :]
+    agree = (s_diff * l_diff) > 0
+    tie = s_diff == 0
+    pos = jnp.sum(pair & agree & ~tie)
+    neu = jnp.sum(pair & tie)
+    neg = jnp.sum(pair) - pos - neu
+    return pos, neg, neu
+
+
+def tree_conv(nodes, adjacency, weights, bias=None):
+    """Tree-based convolution (reference tree_conv op,
+    operators/tree_conv_op.cc — TBCNN continuous binary tree conv).
+
+    nodes: [N, F] node features; adjacency: [N, N] bool, adjacency[p, c]
+    True when c is a child of p; weights: [F, 3, O] — the (top, left,
+    right) basis matrices. Each node's receptive patch is itself (top
+    basis) plus its children mixed between the left/right bases by their
+    normalized sibling position. Returns [N, O].
+    """
+    n = nodes.shape[0]
+    adj = adjacency.astype(jnp.float32)                      # [N, N]
+    nc = jnp.sum(adj, axis=1, keepdims=True)                 # children/node
+    # sibling position r in [0, 1]: rank of child among its siblings
+    order = jnp.cumsum(adj, axis=1) * adj                    # 1-based ranks
+    denom = jnp.maximum(nc - 1.0, 1.0)
+    r = (order - 1.0) / denom * adj                          # [N, N]
+    eta_l = (1.0 - r) * adj
+    eta_r = r * adj
+    w_t, w_l, w_r = weights[:, 0], weights[:, 1], weights[:, 2]  # [F, O]
+    out = nodes @ w_t                                        # self/top term
+    out = out + (eta_l @ nodes) @ w_l + (eta_r @ nodes) @ w_r
+    if bias is not None:
+        out = out + bias
+    return out

@@ -1,0 +1,393 @@
+"""Functional op library: activations, losses, reductions, elementwise.
+
+Capability-equivalent of reference op families:
+- activations: operators/activation_op.cc (relu, sigmoid, tanh, sqrt, abs,
+  ceil, floor, exp, log, square, softplus, softsign, brelu, leaky_relu,
+  soft_relu, elu, relu6, pow, stanh, hard_sigmoid, swish, ...)
+- softmax / log_softmax: operators/softmax_op.cc
+- cross_entropy / softmax_with_cross_entropy:
+  operators/cross_entropy_op.cc, softmax_with_cross_entropy_op.cc
+- elementwise add/sub/mul/div/min/max/pow with numpy broadcasting:
+  operators/elementwise/ (XLA broadcasting subsumes the axis-broadcast attr)
+- reductions: operators/reduce_ops/
+- misc tensor ops: one_hot, clip, scale, sign, cumsum, topk, argsort, ...
+
+All are thin, jit-safe wrappers over jax.numpy/lax — XLA fuses elementwise
+chains into neighbouring MXU ops, which is exactly the capability the
+reference's fuse passes (ir/fuse_elewise_add_act_pass.cc) hand-implement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ---------------------------------------------------------------- activations
+
+relu = jax.nn.relu
+relu6 = jax.nn.relu6
+sigmoid = jax.nn.sigmoid
+tanh = jnp.tanh
+softplus = jax.nn.softplus
+softsign = jax.nn.soft_sign
+elu = jax.nn.elu
+gelu = jax.nn.gelu
+silu = jax.nn.silu
+
+
+def leaky_relu(x, alpha: float = 0.02):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    return jnp.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold: float = 40.0):
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def swish(x, beta: float = 1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def maxout(x, groups: int):
+    """operators/maxout_op: max over `groups` consecutive channels per
+    output channel (reference math/maxouting.cc layout)."""
+    c = x.shape[-1]
+    return jnp.max(x.reshape(x.shape[:-1] + (c // groups, groups)), axis=-1)
+
+
+ACTIVATIONS = {
+    None: lambda x: x, "linear": lambda x: x, "relu": relu, "relu6": relu6,
+    "sigmoid": sigmoid, "tanh": tanh, "softplus": softplus,
+    "softsign": softsign, "elu": elu, "gelu": gelu, "silu": silu,
+    "leaky_relu": leaky_relu, "swish": swish, "brelu": brelu,
+    "hard_sigmoid": hard_sigmoid, "stanh": stanh, "soft_relu": soft_relu,
+}
+
+
+def activation(name):
+    if callable(name):
+        return name
+    if name not in ACTIVATIONS:
+        raise ValueError(f"unknown activation {name!r}")
+    return ACTIVATIONS[name]
+
+
+# -------------------------------------------------------------------- softmax
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# --------------------------------------------------------------------- losses
+
+def cross_entropy(probs, label, soft_label: bool = False, axis: int = -1,
+                  epsilon: float = 1e-12):
+    """Reference cross_entropy op: input is a probability distribution."""
+    logp = jnp.log(jnp.maximum(probs, epsilon))
+    if soft_label:
+        return -jnp.sum(label * logp, axis=axis)
+    idx = jnp.expand_dims(label.astype(jnp.int32), axis)
+    return -jnp.squeeze(jnp.take_along_axis(logp, idx, axis=axis), axis)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               axis: int = -1, ignore_index: int = -100):
+    """Fused, numerically-stable version (reference
+    softmax_with_cross_entropy_op.cc). Returns per-example loss.
+
+    Hard-label path computes nll = logsumexp(logits) - logits[label]
+    directly: only reductions and a gather touch HBM, never a
+    materialized [*, V] log-softmax tensor — at a 32k vocab that fp32
+    tensor costs ~4 GB/step of pure bandwidth (v5e trace, round 3)."""
+    f32 = jnp.promote_types(logits.dtype, jnp.float32)
+    if soft_label:
+        logp = jax.nn.log_softmax(logits.astype(f32), axis=axis)
+        return -jnp.sum(label * logp, axis=axis)
+    label = label.astype(jnp.int32)
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    lse = jax.scipy.special.logsumexp(logits.astype(f32), axis=axis)
+    picked = jnp.squeeze(jnp.take_along_axis(
+        logits, jnp.expand_dims(safe, axis), axis=axis), axis).astype(f32)
+    return jnp.where(valid, lse - picked, 0.0)
+
+
+def sigmoid_cross_entropy_with_logits(logits, label):
+    """operators/sigmoid_cross_entropy_with_logits_op.cc."""
+    ct = jnp.promote_types(logits.dtype, jnp.float32)
+    x = logits.astype(ct)
+    z = label.astype(ct)
+    return jnp.maximum(x, 0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def square_error_cost(pred, label):
+    """operators/squared_l2_distance / fluid.layers.square_error_cost."""
+    return jnp.square(pred - label)
+
+
+def smooth_l1(x, y, sigma: float = 1.0):
+    """operators/smooth_l1_loss_op.cc."""
+    s2 = sigma * sigma
+    d = x - y
+    a = jnp.abs(d)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * d * d, a - 0.5 / s2)
+
+
+def huber_loss(x, y, delta: float = 1.0):
+    d = jnp.abs(x - y)
+    return jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+
+
+def kldiv_loss(logp, target):
+    return target * (jnp.log(jnp.maximum(target, 1e-12)) - logp)
+
+
+def margin_rank_loss(left, right, label, margin: float = 0.1):
+    return jnp.maximum(0.0, -label * (left - right) + margin)
+
+
+def hinge_loss(logits, label):
+    return jnp.maximum(0.0, 1.0 - (2.0 * label - 1.0) * logits)
+
+
+def log_loss(probs, label, epsilon: float = 1e-4):
+    p = jnp.clip(probs, epsilon, 1.0 - epsilon)
+    return -(label * jnp.log(p) + (1.0 - label) * jnp.log(1.0 - p))
+
+
+def mse_loss(pred, label):
+    return jnp.mean(jnp.square(pred - label))
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True)
+                         + epsilon)
+
+
+def cos_sim(a, b, axis: int = -1, epsilon: float = 1e-12):
+    """operators/cos_sim_op.cc."""
+    na = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis) + epsilon)
+    nb = jnp.sqrt(jnp.sum(jnp.square(b), axis=axis) + epsilon)
+    return jnp.sum(a * b, axis=axis) / (na * nb)
+
+
+# ---------------------------------------------------------------- elementwise
+# XLA/numpy broadcasting subsumes the reference's `axis` broadcast attr.
+
+elementwise_add = jnp.add
+elementwise_sub = jnp.subtract
+elementwise_mul = jnp.multiply
+elementwise_div = jnp.divide
+elementwise_min = jnp.minimum
+elementwise_max = jnp.maximum
+elementwise_pow = jnp.power
+elementwise_mod = jnp.mod
+elementwise_floordiv = jnp.floor_divide
+
+
+# ----------------------------------------------------------------- reductions
+
+def reduce_sum(x, dim=None, keep_dim: bool = False):
+    return jnp.sum(x, axis=_axes(dim), keepdims=keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim: bool = False):
+    return jnp.mean(x, axis=_axes(dim), keepdims=keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim: bool = False):
+    return jnp.max(x, axis=_axes(dim), keepdims=keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim: bool = False):
+    return jnp.min(x, axis=_axes(dim), keepdims=keep_dim)
+
+
+def reduce_prod(x, dim=None, keep_dim: bool = False):
+    return jnp.prod(x, axis=_axes(dim), keepdims=keep_dim)
+
+
+def _axes(dim):
+    if dim is None:
+        return None
+    return tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+
+
+# -------------------------------------------------------------- tensor munge
+
+def one_hot(ids, depth: int, dtype=jnp.float32):
+    return jax.nn.one_hot(ids, depth, dtype=dtype)
+
+
+def clip(x, min: float, max: float):
+    return jnp.clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm: float):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return x * jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+
+
+def scale(x, scale: float = 1.0, bias: float = 0.0,
+          bias_after_scale: bool = True):
+    return x * scale + bias if bias_after_scale else (x + bias) * scale
+
+
+def topk(x, k: int):
+    return lax.top_k(x, k)
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    idx = jnp.argsort(-x if descending else x, axis=axis)
+    return jnp.take_along_axis(x, idx, axis=axis), idx
+
+
+def concat(xs, axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def split(x, num_or_sections, axis: int = 0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    offsets = np.cumsum(np.asarray(num_or_sections))[:-1]
+    return jnp.split(x, [int(o) for o in offsets], axis=axis)
+
+
+def stack(xs, axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def squeeze(x, axes=None):
+    if axes is None:
+        return jnp.squeeze(x)
+    if isinstance(axes, int):
+        axes = (axes,)
+    return jnp.squeeze(x, axis=tuple(axes))
+
+
+def unsqueeze(x, axes):
+    for a in sorted(_axes(axes)):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def expand(x, times: Sequence[int]):
+    """operators/expand_op: tile each dim by times[i]."""
+    return jnp.tile(x, times)
+
+
+def gather(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """operators/scatter_op: write rows of `updates` at `index`."""
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def cumsum(x, axis: int = 0, exclusive: bool = False, reverse: bool = False):
+    if reverse:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        out = out - x
+    if reverse:
+        out = jnp.flip(out, axis)
+    return out
+
+
+def shard_index(ids, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1):
+    """operators/shard_index_op: map global ids to shard-local or ignore."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (ids // shard_size) == shard_id
+    return jnp.where(in_shard, ids % shard_size, ignore_value)
+
+
+def label_smooth(label, epsilon: float = 0.1, prior=None):
+    k = label.shape[-1]
+    uniform = (1.0 / k) if prior is None else prior
+    return (1.0 - epsilon) * label + epsilon * uniform
+
+
+def pad(x, paddings, pad_value: float = 0.0):
+    """operators/pad_op: paddings = [(lo, hi), ...] per dim."""
+    return jnp.pad(x, paddings, constant_values=pad_value)
+
+
+def pixel_shuffle(x, upscale: int):
+    n, h, w, c = x.shape
+    r = upscale
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def resize_nearest(x, out_shape):
+    """operators/interpolate_op (nearest). NHWC."""
+    n, h, w, c = x.shape
+    oh, ow = out_shape
+    ridx = (jnp.arange(oh) * h // oh).astype(jnp.int32)
+    cidx = (jnp.arange(ow) * w // ow).astype(jnp.int32)
+    return x[:, ridx][:, :, cidx]
+
+
+def resize_bilinear(x, out_shape, align_corners: bool = False):
+    """operators/interpolate_op bilinear. align_corners=True samples the
+    corner-aligned grid (the fluid default); False = half-pixel
+    (jax.image.resize semantics)."""
+    oh, ow = out_shape
+    if not align_corners:
+        return jax.image.resize(
+            x, (x.shape[0], oh, ow, x.shape[3]), "bilinear")
+    h, w = x.shape[1], x.shape[2]
+    ys = (jnp.linspace(0.0, h - 1.0, oh) if oh > 1
+          else jnp.zeros((1,)))
+    xs = (jnp.linspace(0.0, w - 1.0, ow) if ow > 1
+          else jnp.zeros((1,)))
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+    bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+    return top * (1 - wy) + bot * wy

@@ -1,0 +1,203 @@
+"""Sequence-lattice dynamic programs: linear-chain CRF and CTC.
+
+Capability-equivalent of the reference's structured-prediction ops:
+- linear_chain_crf (operators/linear_chain_crf_op.cc: forward-algorithm
+  log-likelihood over a transition matrix; the label_semantic_roles book
+  chapter trains with it);
+- crf_decoding (operators/crf_decoding_op.cc: Viterbi);
+- warpctc (operators/warpctc_op.cc wrapping the warp-ctc CUDA library) —
+  here a native CTC forward in logspace;
+- ctc_align (operators/ctc_align_op.cc: collapse repeats + strip blanks).
+
+All are `lax.scan` dynamic programs over the time axis — one compiled
+program, static shapes, lengths handled by masking (the TPU formulation
+of the reference's LoD-batched lattices).
+
+Transition-matrix layout follows the reference (linear_chain_crf_op.h):
+transitions[0] = start weights, transitions[1] = stop weights,
+transitions[2:] = [num_tags, num_tags] pairwise weights (from, to).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+def _crf_unpack(transitions):
+    return transitions[0], transitions[1], transitions[2:]
+
+
+def crf_forward(emissions, transitions, lengths=None):
+    """Log partition function of a linear-chain CRF.
+
+    emissions: [B, T, K] unary scores; transitions: [K+2, K] (see module
+    docstring); lengths: [B] or None. Returns log Z [B]."""
+    b, t, k = emissions.shape
+    start, stop, pair = _crf_unpack(transitions)
+    alpha0 = start[None, :] + emissions[:, 0]          # [B, K]
+
+    def step(alpha, te):
+        pos, e_t = te
+        # logsumexp over previous tag
+        scores = alpha[:, :, None] + pair[None] + e_t[:, None, :]
+        new_alpha = jax.scipy.special.logsumexp(scores, axis=1)
+        if lengths is not None:
+            alive = (pos < lengths)[:, None]
+            new_alpha = jnp.where(alive, new_alpha, alpha)
+        return new_alpha, None
+
+    xs = (jnp.arange(1, t), jnp.moveaxis(emissions[:, 1:], 1, 0))
+    alpha, _ = lax.scan(step, alpha0, xs)
+    return jax.scipy.special.logsumexp(alpha + stop[None, :], axis=-1)
+
+
+def crf_score(emissions, tags, transitions, lengths=None):
+    """Score of a given tag path (the numerator of the CRF likelihood)."""
+    b, t, k = emissions.shape
+    start, stop, pair = _crf_unpack(transitions)
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    pos = jnp.arange(t)
+    valid = pos[None, :] < lengths[:, None]            # [B, T]
+    unary = jnp.take_along_axis(emissions, tags[..., None], axis=2)[..., 0]
+    unary = jnp.sum(jnp.where(valid, unary, 0.0), axis=1)
+    trans = pair[tags[:, :-1], tags[:, 1:]]            # [B, T-1]
+    tvalid = pos[None, 1:] < lengths[:, None]
+    trans = jnp.sum(jnp.where(tvalid, trans, 0.0), axis=1)
+    last = jnp.take_along_axis(tags, (lengths - 1)[:, None], axis=1)[:, 0]
+    return unary + trans + start[tags[:, 0]] + stop[last]
+
+
+def linear_chain_crf(emissions, tags, transitions, lengths=None):
+    """Negative log-likelihood per sequence (linear_chain_crf op's output
+    is the likelihood; we return NLL for direct minimisation)."""
+    return crf_forward(emissions, transitions, lengths) \
+        - crf_score(emissions, tags, transitions, lengths)
+
+
+def crf_decoding(emissions, transitions, lengths=None):
+    """Viterbi decode (crf_decoding op). Returns (tags [B, T], score [B]);
+    positions past a row's length hold 0."""
+    b, t, k = emissions.shape
+    start, stop, pair = _crf_unpack(transitions)
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    delta0 = start[None, :] + emissions[:, 0]
+
+    def fwd(delta, te):
+        pos, e_t = te
+        scores = delta[:, :, None] + pair[None]        # [B, K, K]
+        best_prev = jnp.argmax(scores, axis=1)         # [B, K]
+        new_delta = jnp.max(scores, axis=1) + e_t
+        alive = (pos < lengths)[:, None]
+        new_delta = jnp.where(alive, new_delta, delta)
+        # frozen rows keep identity backpointers
+        ident = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+        bp = jnp.where(alive, best_prev, ident)
+        return new_delta, bp
+
+    xs = (jnp.arange(1, t), jnp.moveaxis(emissions[:, 1:], 1, 0))
+    delta, bps = lax.scan(fwd, delta0, xs)             # bps: [T-1, B, K]
+    final = delta + stop[None, :]
+    score = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev.astype(jnp.int32), tag
+
+    # reverse scan: ys[i] = tag at time i+1; final carry = tag at time 0
+    tag0, tags_rest = lax.scan(back, last_tag, bps, reverse=True)
+    tags = jnp.concatenate([tag0[:, None],
+                            jnp.moveaxis(tags_rest, 0, 1)], axis=1)
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    return jnp.where(mask, tags, 0), score
+
+
+# ------------------------------------------------------------------- CTC
+
+def ctc_loss(log_probs, labels, input_lengths=None, label_lengths=None,
+             blank: int = 0):
+    """CTC negative log-likelihood (warpctc capability).
+
+    log_probs: [B, T, V] log-softmax outputs; labels: [B, L] (no blanks);
+    lengths default to full. Standard alpha recursion over the extended
+    label sequence (blank-interleaved, length 2L+1) in logspace."""
+    b, t, v = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+    if input_lengths is None:
+        input_lengths = jnp.full((b,), t, jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((b,), l, jnp.int32)
+
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(s)
+    ext_valid = pos[None, :] < (2 * label_lengths + 1)[:, None]
+
+    # can-skip: ext[i] != blank and ext[i] != ext[i-2]
+    skip_ok = jnp.zeros((b, s), bool)
+    skip_ok = skip_ok.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    def emit(t_idx):
+        # log_probs of each extended symbol at time t: [B, S]
+        return jnp.take_along_axis(log_probs[:, t_idx], ext, axis=1)
+
+    alpha = jnp.full((b, s), NEG_INF)
+    alpha = alpha.at[:, 0].set(log_probs[:, 0, blank])
+    first_lbl = jnp.take_along_axis(log_probs[:, 0], labels[:, :1], axis=1)
+    alpha = alpha.at[:, 1].set(jnp.where(label_lengths > 0,
+                                         first_lbl[:, 0], NEG_INF))
+
+    def step(alpha, t_idx):
+        prev1 = jnp.concatenate(
+            [jnp.full((b, 1), NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((b, 2), NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new_alpha = merged + emit(t_idx)
+        new_alpha = jnp.where(ext_valid, new_alpha, NEG_INF)
+        alive = (t_idx < input_lengths)[:, None]
+        return jnp.where(alive, new_alpha, alpha), None
+
+    alpha, _ = lax.scan(step, alpha, jnp.arange(1, t))
+    # total prob = alpha[last blank] + alpha[last label]
+    last = 2 * label_lengths                          # index of final blank
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(label_lengths > 0, a_prev, NEG_INF)
+    return -jnp.logaddexp(a_last, a_prev)
+
+
+def ctc_align(tokens, lengths=None, blank: int = 0,
+              pad_value: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Collapse repeats then remove blanks (ctc_align op). tokens [B, T]
+    -> (aligned [B, T] left-compacted + padded, new_lengths [B])."""
+    b, t = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, tokens.dtype), tokens[:, :-1]], axis=1)
+    keep = valid & (tokens != blank) & (tokens != prev)
+    new_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    target = jnp.cumsum(keep, axis=1) - 1
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    tgt = jnp.where(keep, target, t - 1).astype(jnp.int32)
+    # add-combine into zeros is exact: each kept token has a unique target
+    # slot, and dropped tokens contribute 0 at the dump slot t-1
+    out = jnp.zeros((b, t), tokens.dtype).at[bidx, tgt].add(
+        jnp.where(keep, tokens, 0))
+    mask = jnp.arange(t)[None, :] < new_len[:, None]
+    return jnp.where(mask, out, pad_value), new_len

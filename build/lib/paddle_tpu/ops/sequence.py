@@ -1,0 +1,300 @@
+"""Ragged-sequence ops — the TPU-native replacement for LoD.
+
+Capability-equivalent of the reference's LoD machinery + sequence op family:
+- LoDTensor offset tables (framework/lod_tensor.h:58): variable-length
+  sequences concatenated with nesting offsets. TPU idiom: EITHER dense
+  padded [batch, max_len, ...] with `lengths`, OR packed [total, ...] with
+  `segment_ids` — both static-shaped, XLA-friendly; conversions below.
+- operators/sequence_ops/ (18 ops): sequence_pool, sequence_softmax,
+  sequence_expand, sequence_concat, sequence_reverse, sequence_pad/unpad,
+  sequence_mask, sequence_first/last_step, sequence_erase,
+  sequence_enumerate, sequence_conv, sequence_slice, sequence_scatter.
+
+All functions are jit-safe with static shapes; `num_segments`/`maxlen` are
+static ints. Masked/segment formulations replace the reference's per-sequence
+C++ loops with vectorised MXU/VPU-friendly compute.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+# --------------------------------------------------------- padded <-> packed
+
+class Ragged(NamedTuple):
+    """Packed ragged batch: rows of all sequences concatenated.
+
+    data: [total, ...]; segment_ids: [total] int32 (row -> sequence index,
+    padding rows get `num_segments`); lengths: [batch].
+    Same information as a level-1 LoD (lod_tensor.h:44-58 offsets), in the
+    segment-id form every TPU sparse/ragged kernel expects.
+    """
+    data: jax.Array
+    segment_ids: jax.Array
+    lengths: jax.Array
+
+    @property
+    def num_segments(self) -> int:
+        return self.lengths.shape[0]
+
+
+def sequence_mask(lengths, maxlen: int, dtype=jnp.bool_):
+    """[B] lengths -> [B, maxlen] mask (operators/sequence_ops/
+    sequence_mask_op.cc)."""
+    pos = jnp.arange(maxlen)
+    return (pos[None, :] < lengths[:, None]).astype(dtype)
+
+
+def pack_padded(x, lengths) -> Ragged:
+    """Dense [B, T, ...] + lengths -> packed Ragged with total = B*T rows
+    (padding rows keep segment_id == B so segment ops drop them)."""
+    b, t = x.shape[0], x.shape[1]
+    mask = sequence_mask(lengths, t)
+    seg = jnp.where(mask, jnp.arange(b, dtype=jnp.int32)[:, None], b)
+    return Ragged(data=x.reshape((b * t,) + x.shape[2:]),
+                  segment_ids=seg.reshape(-1),
+                  lengths=lengths)
+
+
+def pad_packed(r: Ragged, maxlen: int):
+    """Packed -> dense [B, maxlen, ...] + mask (sequence_pad_op.cc)."""
+    b = r.num_segments
+    total = r.data.shape[0]
+    # position of each row within its sequence
+    onehot = (r.segment_ids[:, None] == jnp.arange(b)[None, :])
+    pos_in_seq = jnp.cumsum(onehot, axis=0) - 1
+    pos = jnp.take_along_axis(pos_in_seq, r.segment_ids[:, None] % b,
+                              axis=1)[:, 0]
+    out = jnp.zeros((b, maxlen) + r.data.shape[1:], r.data.dtype)
+    valid = r.segment_ids < b
+    idx_b = jnp.where(valid, r.segment_ids, 0)
+    idx_t = jnp.where(valid, jnp.minimum(pos, maxlen - 1), 0)
+    upd = jnp.where(
+        valid.reshape((-1,) + (1,) * (r.data.ndim - 1)), r.data, 0)
+    out = out.at[idx_b, idx_t].add(upd)
+    return out, sequence_mask(r.lengths, maxlen)
+
+
+# ------------------------------------------------------------- pooling/steps
+
+def sequence_pool(x, lengths, pool_type: str = "sum"):
+    """Pool over time of a padded batch [B, T, D] (sequence_pool_op.cc:
+    sum/average/sqrt/max/last/first)."""
+    t = x.shape[1]
+    mask = sequence_mask(lengths, t, x.dtype)[..., None]
+    if pool_type == "sum":
+        return jnp.sum(x * mask, axis=1)
+    if pool_type in ("average", "mean"):
+        denom = jnp.maximum(lengths, 1).astype(x.dtype)[:, None]
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(lengths, 1).astype(x.dtype))[:, None]
+        return jnp.sum(x * mask, axis=1) / denom
+    if pool_type == "max":
+        neg = jnp.where(mask > 0, x, NEG_INF)
+        return jnp.max(neg, axis=1)
+    if pool_type == "first":
+        return x[:, 0]
+    if pool_type == "last":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, "last")
+
+
+def segment_pool(r: Ragged, pool_type: str = "sum"):
+    """Pool a packed Ragged [total, D] -> [B, D] via segment ops (the packed
+    counterpart of sequence_pool; XLA lowers segment_sum to one-hot matmul
+    on TPU which rides the MXU)."""
+    b = r.num_segments
+    if pool_type == "sum":
+        return jax.ops.segment_sum(r.data, r.segment_ids, num_segments=b + 1
+                                   )[:b]
+    if pool_type in ("average", "mean"):
+        s = jax.ops.segment_sum(r.data, r.segment_ids, num_segments=b + 1)[:b]
+        return s / jnp.maximum(r.lengths, 1).astype(s.dtype)[:, None]
+    if pool_type == "max":
+        return jax.ops.segment_max(r.data, r.segment_ids, num_segments=b + 1
+                                   )[:b]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+# ---------------------------------------------------------------- softmax
+
+def sequence_softmax(x, lengths):
+    """Masked softmax over time [B, T] or [B, T, D]-last-dim=scores
+    (sequence_softmax_op.cc)."""
+    t = x.shape[1]
+    mask = sequence_mask(lengths, t, jnp.bool_)
+    shape = (mask.shape[0], t) + (1,) * (x.ndim - 2)
+    m = mask.reshape(shape)
+    z = jnp.where(m, x, NEG_INF)
+    z = z - jnp.max(z, axis=1, keepdims=True)
+    e = jnp.exp(z) * m.astype(x.dtype)
+    return e / jnp.maximum(jnp.sum(e, axis=1, keepdims=True), 1e-12)
+
+
+# ------------------------------------------------------- expand/concat/etc.
+
+def sequence_expand_padded(x, ref_lengths, maxlen: int):
+    """x: [B, D] -> [B, maxlen, D] with rows masked beyond ref_lengths."""
+    mask = sequence_mask(ref_lengths, maxlen, x.dtype)
+    return x[:, None, :] * mask[..., None]
+
+
+def sequence_expand_as(x, ref_lengths, maxlen: int):
+    """Reference sequence_expand_as op (sequence_expand_as_op.cc): repeat
+    row i of x ref_lengths[i] times. Padded form: [B, D] -> [B, maxlen, D]
+    with positions beyond ref_lengths[i] zeroed (same contract as
+    sequence_expand_padded, kept as a named alias for API parity)."""
+    return sequence_expand_padded(x, ref_lengths, maxlen)
+
+
+def sequence_reshape(x, lengths, new_dim: int):
+    """Reference sequence_reshape op (sequence_reshape_op.cc): reinterpret
+    each sequence's [len_i, D] payload as [len_i*D/new_dim, new_dim].
+    Padded form: [B, T, D] -> [B, T*D//new_dim, new_dim] + new lengths.
+    Requires (T*D) % new_dim == 0 for the padded buffer."""
+    b, t, d = x.shape
+    assert (t * d) % new_dim == 0, "padded payload must divide new_dim"
+    new_t = t * d // new_dim
+    out = x.reshape(b, new_t, new_dim)
+    new_lengths = (lengths * d) // new_dim
+    mask = sequence_mask(new_lengths, new_t, x.dtype)
+    return out * mask[..., None], new_lengths
+
+
+def sequence_scatter(x, index, updates, updates_lengths):
+    """Reference sequence_scatter op (sequence_scatter_op.cc): per sample i,
+    x[i, index[i, j]] += updates[i, j] for j < updates_lengths[i].
+    x: [B, N]; index/updates: [B, T]."""
+    b, t = index.shape
+    mask = sequence_mask(updates_lengths, t, updates.dtype)
+    upd = updates * mask
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    return x.at[bidx, index.astype(jnp.int32)].add(upd)
+
+
+def sequence_reverse(x, lengths):
+    """Reverse valid prefix of each row [B, T, ...]
+    (sequence_reverse_op.cc)."""
+    t = x.shape[1]
+    pos = jnp.arange(t)
+    rev_idx = lengths[:, None] - 1 - pos[None, :]
+    idx = jnp.where(rev_idx >= 0, rev_idx, pos[None, :])
+    return jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+
+
+def sequence_concat(xs, lengths_list, maxlen: int):
+    """Concatenate per-sample sequences from several padded batches
+    (sequence_concat_op.cc). Returns padded [B, maxlen, D] + new lengths."""
+    b = xs[0].shape[0]
+    d_shape = xs[0].shape[2:]
+    out = jnp.zeros((b, maxlen) + d_shape, xs[0].dtype)
+    total = jnp.zeros((b,), lengths_list[0].dtype)
+    for x, lens in zip(xs, lengths_list):
+        t = x.shape[1]
+        mask = sequence_mask(lens, t, jnp.bool_)
+        tpos = total[:, None] + jnp.arange(t)[None, :]
+        idx_t = jnp.where(mask, tpos, maxlen - 1).astype(jnp.int32)
+        upd = jnp.where(mask.reshape(mask.shape + (1,) * len(d_shape)), x, 0)
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+        out = out.at[bidx, idx_t].add(upd)
+        total = total + lens
+    return out, total
+
+
+def sequence_slice(x, lengths, offset, length):
+    """Per-sequence slice (sequence_slice_op.cc): take `length[i]` steps from
+    `offset[i]` of each row. Output padded to static max `length` bound."""
+    t = x.shape[1]
+    max_out = int(length) if jnp.ndim(length) == 0 else t
+    starts = jnp.broadcast_to(jnp.asarray(offset), lengths.shape)
+    lens = jnp.broadcast_to(jnp.asarray(length), lengths.shape)
+    pos = jnp.arange(max_out)
+    idx = jnp.minimum(starts[:, None] + pos[None, :], t - 1).astype(jnp.int32)
+    out = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return out, lens
+
+
+def sequence_erase(tokens, lengths, erase_tokens):
+    """Remove given token values, left-compacting each row; returns new
+    padded tokens + new lengths (sequence_erase_op.cc). tokens: [B, T]."""
+    t = tokens.shape[1]
+    keep = sequence_mask(lengths, t, jnp.bool_)
+    for e in erase_tokens:
+        keep = keep & (tokens != e)
+    new_len = jnp.sum(keep, axis=1)
+    # stable left-compaction: target position of each kept token
+    target = jnp.cumsum(keep, axis=1) - 1
+    out = jnp.zeros_like(tokens)
+    bidx = jnp.broadcast_to(jnp.arange(tokens.shape[0])[:, None],
+                            tokens.shape)
+    tgt = jnp.where(keep, target, t - 1).astype(jnp.int32)
+    upd = jnp.where(keep, tokens, 0)
+    out = out.at[bidx, tgt].max(upd)
+    # zero any tail garbage
+    out = out * sequence_mask(new_len, t, tokens.dtype)
+    return out, new_len
+
+
+def sequence_enumerate(tokens, lengths, win_size: int, pad_value: int = 0):
+    """Sliding windows of ids (sequence_enumerate_op.cc): [B, T] ->
+    [B, T, win_size]; positions past each row's length get pad_value."""
+    t = tokens.shape[1]
+    idx = jnp.arange(t)[:, None] + jnp.arange(win_size)[None, :]
+    valid = idx < lengths[:, None, None]
+    idxc = jnp.minimum(idx, t - 1)
+    wins = tokens[:, idxc]
+    return jnp.where(valid, wins, pad_value)
+
+
+def sequence_conv(x, lengths, filter_w, context_size: int = 3,
+                  context_start: Optional[int] = None):
+    """Context-window convolution over time (sequence_conv_op.cc +
+    math/context_project.h): concatenate a window of steps then project.
+    x: [B, T, D]; filter_w: [context_size*D, out]. Windows never cross
+    sequence boundaries (padding is masked)."""
+    b, t, d = x.shape
+    start = -(context_size // 2) if context_start is None else context_start
+    mask = sequence_mask(lengths, t, x.dtype)[..., None]
+    xm = x * mask
+    cols = []
+    for k in range(context_size):
+        shift = start + k
+        rolled = jnp.roll(xm, -shift, axis=1)
+        pos = jnp.arange(t) + shift
+        ok = ((pos >= 0) & (pos < t)).astype(x.dtype)[None, :, None]
+        cols.append(rolled * ok)
+    ctx = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*D]
+    out = jnp.einsum("btc,co->bto", ctx, filter_w)
+    return out * mask
+
+
+# ---------------------------------------------------------------- shrinking
+
+def shrink_memory(state, step: int, rank_lengths):
+    """DynamicRNN memory-shrink capability (shrink_memory op,
+    control_flow.py:963): zero out rows whose sequence already ended at
+    `step` — in static-shape land we mask instead of physically shrinking."""
+    alive = (rank_lengths > step)
+    shape = (state.shape[0],) + (1,) * (state.ndim - 1)
+    return state * alive.reshape(shape).astype(state.dtype)

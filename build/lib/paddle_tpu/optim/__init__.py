@@ -1,0 +1,6 @@
+from paddle_tpu.optim.optimizer import (
+    Optimizer, SGD, Momentum, LarsMomentum, Adagrad, DecayedAdagrad, Adam,
+    AdamW, Adamax, Adadelta, RMSProp, Ftrl, ProximalGD, ProximalAdagrad,
+    Lamb, ModelAverage,
+)
+from paddle_tpu.optim import lr_schedules

@@ -1,0 +1,109 @@
+"""Learning-rate schedules.
+
+Capability parity with reference layers/learning_rate_scheduler.py
+(exponential_decay, natural_exp_decay, inverse_time_decay, polynomial_decay,
+piecewise_decay, cosine_decay, noam_decay, linear_lr_warmup, append_LARS).
+The reference builds these as in-graph ops; here each is a pure function
+`step -> lr` evaluated inside the jitted train step, which compiles to the
+same thing XLA-side.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(value: float) -> Schedule:
+    return lambda step: jnp.asarray(value, jnp.float32)
+
+
+def exponential_decay(learning_rate: float, decay_steps: int,
+                      decay_rate: float, staircase: bool = False) -> Schedule:
+    def sched(step):
+        exp = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            exp = jnp.floor(exp)
+        return learning_rate * decay_rate ** exp
+    return sched
+
+
+def natural_exp_decay(learning_rate: float, decay_steps: int,
+                      decay_rate: float, staircase: bool = False) -> Schedule:
+    def sched(step):
+        exp = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            exp = jnp.floor(exp)
+        return learning_rate * jnp.exp(-decay_rate * exp)
+    return sched
+
+
+def inverse_time_decay(learning_rate: float, decay_steps: int,
+                       decay_rate: float, staircase: bool = False) -> Schedule:
+    def sched(step):
+        t = step.astype(jnp.float32) / decay_steps
+        if staircase:
+            t = jnp.floor(t)
+        return learning_rate / (1.0 + decay_rate * t)
+    return sched
+
+
+def polynomial_decay(learning_rate: float, decay_steps: int,
+                     end_learning_rate: float = 1e-4, power: float = 1.0,
+                     cycle: bool = False) -> Schedule:
+    def sched(step):
+        s = step.astype(jnp.float32)
+        if cycle:
+            mult = jnp.maximum(1.0, jnp.ceil(s / decay_steps))
+            ds = decay_steps * mult
+        else:
+            ds = jnp.asarray(decay_steps, jnp.float32)
+            s = jnp.minimum(s, ds)
+        return (learning_rate - end_learning_rate) * \
+            (1.0 - s / ds) ** power + end_learning_rate
+    return sched
+
+
+def piecewise_decay(boundaries: Sequence[int],
+                    values: Sequence[float]) -> Schedule:
+    bs = jnp.asarray(boundaries, jnp.int32)
+    vs = jnp.asarray(values, jnp.float32)
+
+    def sched(step):
+        idx = jnp.sum((step >= bs).astype(jnp.int32))
+        return vs[idx]
+    return sched
+
+
+def cosine_decay(learning_rate: float, step_each_epoch: int,
+                 epochs: int) -> Schedule:
+    def sched(step):
+        epoch = jnp.floor(step.astype(jnp.float32) / step_each_epoch)
+        frac = jnp.minimum(epoch / epochs, 1.0)
+        return learning_rate * 0.5 * (jnp.cos(frac * jnp.pi) + 1.0)
+    return sched
+
+
+def noam_decay(d_model: int, warmup_steps: int,
+               learning_rate: float = 1.0) -> Schedule:
+    """Transformer LR (reference noam_decay; used by dist_transformer)."""
+    def sched(step):
+        s = jnp.maximum(step.astype(jnp.float32), 1.0)
+        return learning_rate * d_model ** -0.5 * jnp.minimum(
+            s ** -0.5, s * warmup_steps ** -1.5)
+    return sched
+
+
+def linear_warmup(base: Schedule, warmup_steps: int,
+                  start_lr: float = 0.0) -> Schedule:
+    """linear_lr_warmup: ramp from start_lr to base over warmup_steps."""
+    def sched(step):
+        s = step.astype(jnp.float32)
+        target = base(step)
+        warm = start_lr + (target - start_lr) * jnp.minimum(
+            s / warmup_steps, 1.0)
+        return jnp.where(s < warmup_steps, warm, target)
+    return sched

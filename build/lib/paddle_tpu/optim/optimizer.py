@@ -1,0 +1,443 @@
+"""Optimizers.
+
+Capability-equivalent of reference optimizer.py:44-1471 (SGD:410,
+Momentum:457, LarsMomentum:542, Adagrad:628, Adam:704, Adamax:864,
+DecayedAdagrad:997, Adadelta:1082, RMSProp:1179, Ftrl:1329,
+ModelAverage:1471) and their C++ op kernels (operators/optimizers/).
+
+Design: each optimizer is a pure (init, update) pair over a parameter
+pytree — the idiomatic XLA formulation. `update` returns (new_params,
+new_opt_state); everything jits, pjits, and shards (optimizer state inherits
+parameter sharding, which is what makes ZeRO-style sharding in
+paddle_tpu.parallel free). Learning rate may be a float or a schedule
+`step -> lr` evaluated inside the traced step (so LR schedules compile into
+the step function, like the reference's in-graph LR schedule ops,
+layers/learning_rate_scheduler.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+Schedule = Callable[[jax.Array], jax.Array]
+LR = Union[float, Schedule]
+
+
+def _lr_at(lr: LR, step: jax.Array) -> jax.Array:
+    return lr(step) if callable(lr) else jnp.asarray(lr, jnp.float32)
+
+
+def _zeros_like(tree: Pytree) -> Pytree:
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _global_norm(tree: Pytree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))
+
+
+class Optimizer:
+    """Base optimizer: subclasses implement init_state and _apply_one.
+
+    `apply(params, grads, state)` maps the per-leaf update across the tree
+    and advances the step counter. Supports:
+    - grad_clip: None | ("value", v) | ("norm", n) | ("global_norm", n)
+      (reference clip.py:120 GradientClipByValue, :166 ByNorm, :212 ByGlobalNorm)
+    - regularization: None | ("l2", coeff) | ("l1", coeff) applied as grad
+      decay (reference regularizer.py:112 L2Decay, :171 L1Decay)
+    """
+
+    def __init__(self, learning_rate: LR = 0.01, grad_clip=None,
+                 regularization=None):
+        self.learning_rate = learning_rate
+        self.grad_clip = grad_clip
+        self.regularization = regularization
+
+    # -- subclass surface -------------------------------------------------
+    def init_slots(self, params: Pytree) -> Dict[str, Pytree]:
+        return {}
+
+    def _apply_one(self, p, g, lr, step, **slots):
+        raise NotImplementedError
+
+    # -- public API -------------------------------------------------------
+    def init(self, params: Pytree) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32),
+                "slots": self.init_slots(params)}
+
+    def apply(self, params: Pytree, grads: Pytree,
+              state: Dict[str, Any]) -> Tuple[Pytree, Dict[str, Any]]:
+        step = state["step"]
+        lr = _lr_at(self.learning_rate, step)
+        grads = self._preprocess(params, grads)
+
+        slots = state["slots"]
+        slot_names = list(slots.keys())
+
+        def leaf_fn(p, g, *slot_leaves):
+            kw = dict(zip(slot_names, slot_leaves))
+            new_p, new_slots = self._apply_one(p, g, lr, step, **kw)
+            return (new_p,) + tuple(new_slots[k] for k in slot_names)
+
+        results = jax.tree.map(leaf_fn, params, grads,
+                               *[slots[k] for k in slot_names])
+        # unzip the per-leaf tuples back into trees
+        new_params = jax.tree.map(lambda t: t[0], results,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        new_slots = {}
+        for i, k in enumerate(slot_names):
+            new_slots[k] = jax.tree.map(lambda t, i=i: t[i + 1], results,
+                                        is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"step": step + 1, "slots": new_slots}
+
+    # -- shared grad pre-processing --------------------------------------
+    def _preprocess(self, params: Pytree, grads: Pytree) -> Pytree:
+        if self.regularization is not None:
+            kind, coeff = self.regularization
+            if kind == "l2":
+                grads = jax.tree.map(lambda g, p: g + coeff * p, grads, params)
+            elif kind == "l1":
+                grads = jax.tree.map(lambda g, p: g + coeff * jnp.sign(p),
+                                     grads, params)
+            else:
+                raise ValueError(f"unknown regularization {kind}")
+        if self.grad_clip is not None:
+            kind, val = self.grad_clip
+            if kind == "value":
+                grads = jax.tree.map(lambda g: jnp.clip(g, -val, val), grads)
+            elif kind == "norm":
+                def clip_norm(g):
+                    n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                    return g * jnp.minimum(1.0, val / jnp.maximum(n, 1e-12))
+                grads = jax.tree.map(clip_norm, grads)
+            elif kind == "global_norm":
+                gn = _global_norm(grads)
+                factor = jnp.minimum(1.0, val / jnp.maximum(gn, 1e-12))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            else:
+                raise ValueError(f"unknown grad_clip {kind}")
+        return grads
+
+    # Convenience mirroring reference Optimizer.minimize.
+    def minimize(self, loss_fn, params, state, *args, **kwargs):
+        (loss, aux), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, *args, **kwargs)
+        new_params, new_state = self.apply(params, grads, state)
+        return loss, aux, new_params, new_state
+
+
+class SGD(Optimizer):
+    """optimizer.py:410 / operators/optimizers/sgd_op.cc."""
+
+    def _apply_one(self, p, g, lr, step):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), {}
+
+
+class Momentum(Optimizer):
+    """optimizer.py:457 / momentum_op.cc (+ use_nesterov)."""
+
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 use_nesterov: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def init_slots(self, params):
+        return {"velocity": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, velocity):
+        g = g.astype(p.dtype)
+        lr = lr.astype(p.dtype)
+        v = self.momentum * velocity + g
+        if self.use_nesterov:
+            new_p = p - lr * (g + self.momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """optimizer.py:542 LarsMomentumOptimizer / lars_momentum_op.cc.
+
+    Layer-wise adaptive LR: local_lr = lr * coeff * ||p|| /
+    (||g|| + weight_decay * ||p||).
+    """
+
+    def __init__(self, learning_rate=0.01, momentum: float = 0.9,
+                 lars_coeff: float = 1e-3, lars_weight_decay: float = 5e-4,
+                 epsilon: float = 1e-9, **kw):
+        super().__init__(learning_rate, **kw)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"velocity": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, velocity):
+        pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+        local_lr = lr * self.lars_coeff * p_norm / (
+            g_norm + self.lars_weight_decay * p_norm + self.epsilon)
+        v = self.momentum * velocity.astype(jnp.float32) + local_lr * (
+            gf + self.lars_weight_decay * pf)
+        return (pf - v).astype(p.dtype), {"velocity": v.astype(velocity.dtype)}
+
+
+class Adagrad(Optimizer):
+    """optimizer.py:628 / adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def init_slots(self, params):
+        return {"moment": jax.tree.map(
+            lambda p: jnp.full_like(p, self.initial_accumulator_value),
+            params)}
+
+    def _apply_one(self, p, g, lr, step, moment):
+        g = g.astype(p.dtype)
+        m = moment + jnp.square(g)
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self.epsilon)
+        return new_p, {"moment": m}
+
+
+class DecayedAdagrad(Optimizer):
+    """optimizer.py:997 / decayed_adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, decay: float = 0.95,
+                 epsilon: float = 1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.decay = decay
+        self.epsilon = epsilon
+
+    def init_slots(self, params):
+        return {"moment": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, moment):
+        g = g.astype(p.dtype)
+        m = self.decay * moment + (1 - self.decay) * jnp.square(g)
+        new_p = p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self.epsilon)
+        return new_p, {"moment": m}
+
+
+class Adam(Optimizer):
+    """optimizer.py:704 / adam_op.cc — bias-corrected Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8,
+                 weight_decay: float = 0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        # decoupled weight decay (AdamW-style; beyond-reference capability)
+        self.weight_decay = weight_decay
+
+    def init_slots(self, params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, m, v):
+        gf = g.astype(jnp.float32)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * gf
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(gf)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self.epsilon)
+        if self.weight_decay:
+            upd = upd + self.weight_decay * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"m": m, "v": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, weight_decay=weight_decay, **kw)
+
+
+class Adamax(Optimizer):
+    """optimizer.py:864 / adamax_op.cc — infinity-norm Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_slots(self, params):
+        return {"m": _zeros_like(params), "u": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, m, u):
+        gf = g.astype(jnp.float32)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * gf
+        u = jnp.maximum(self.beta2 * u, jnp.abs(gf))
+        upd = lr / (1 - self.beta1 ** t) * m / (u + self.epsilon)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), {"m": m, "u": u}
+
+
+class Adadelta(Optimizer):
+    """optimizer.py:1082 / adadelta_op.cc."""
+
+    def __init__(self, learning_rate=1.0, rho: float = 0.95,
+                 epsilon: float = 1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_slots(self, params):
+        return {"avg_sq_grad": _zeros_like(params),
+                "avg_sq_update": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, avg_sq_grad, avg_sq_update):
+        gf = g.astype(jnp.float32)
+        e_g = self.rho * avg_sq_grad + (1 - self.rho) * jnp.square(gf)
+        upd = gf * jnp.sqrt(avg_sq_update + self.epsilon) / \
+            jnp.sqrt(e_g + self.epsilon)
+        e_u = self.rho * avg_sq_update + (1 - self.rho) * jnp.square(upd)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_sq_grad": e_g, "avg_sq_update": e_u}
+
+
+class RMSProp(Optimizer):
+    """optimizer.py:1179 / rmsprop_op.cc (centered + momentum variants)."""
+
+    def __init__(self, learning_rate=0.01, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, **kw):
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def init_slots(self, params):
+        return {"mean_sq": _zeros_like(params),
+                "mean_g": _zeros_like(params),
+                "mom": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, mean_sq, mean_g, mom):
+        gf = g.astype(jnp.float32)
+        ms = self.rho * mean_sq + (1 - self.rho) * jnp.square(gf)
+        if self.centered:
+            mg = self.rho * mean_g + (1 - self.rho) * gf
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+        else:
+            mg = mean_g
+            denom = jnp.sqrt(ms + self.epsilon)
+        mo = self.momentum * mom + lr * gf / denom
+        return (p.astype(jnp.float32) - mo).astype(p.dtype), \
+            {"mean_sq": ms, "mean_g": mg, "mom": mo}
+
+
+class Ftrl(Optimizer):
+    """optimizer.py:1329 / ftrl_op.cc."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
+                 lr_power: float = -0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def init_slots(self, params):
+        return {"squared": _zeros_like(params),
+                "linear": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, squared, linear):
+        pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+        new_sq = squared + jnp.square(gf)
+        lp = -self.lr_power
+        sigma = (new_sq ** lp - squared ** lp) / lr
+        lin = linear + gf - sigma * pf
+        quad = new_sq ** lp / lr + 2 * self.l2
+        pre = jnp.clip(lin, -self.l1, self.l1) - lin
+        new_p = jnp.where(jnp.abs(lin) > self.l1, pre / quad,
+                          jnp.zeros_like(pf))
+        return new_p.astype(p.dtype), {"squared": new_sq, "linear": lin}
+
+
+class ProximalGD(Optimizer):
+    """proximal_gd_op.cc: SGD with l1/l2 proximal projection."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def _apply_one(self, p, g, lr, step):
+        prox = p.astype(jnp.float32) - lr * g.astype(jnp.float32)
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - lr * self.l1, 0.0) / (1.0 + lr * self.l2)
+        return new_p.astype(p.dtype), {}
+
+
+class ProximalAdagrad(Optimizer):
+    """proximal_adagrad_op.cc."""
+
+    def __init__(self, learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
+                 **kw):
+        super().__init__(learning_rate, **kw)
+        self.l1, self.l2 = l1, l2
+
+    def init_slots(self, params):
+        return {"moment": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, moment):
+        gf = g.astype(jnp.float32)
+        m = moment + jnp.square(gf)
+        adapted_lr = lr / jnp.sqrt(m + 1e-12)
+        prox = p.astype(jnp.float32) - adapted_lr * gf
+        new_p = jnp.sign(prox) * jnp.maximum(
+            jnp.abs(prox) - adapted_lr * self.l1, 0.0) / \
+            (1.0 + adapted_lr * self.l2)
+        return new_p.astype(p.dtype), {"moment": m}
+
+
+class Lamb(Optimizer):
+    """LAMB (layer-wise Adam; beyond-reference, needed for BERT-scale LR)."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.01, **kw):
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon, self.weight_decay = epsilon, weight_decay
+
+    def init_slots(self, params):
+        return {"m": _zeros_like(params), "v": _zeros_like(params)}
+
+    def _apply_one(self, p, g, lr, step, m, v):
+        gf = g.astype(jnp.float32)
+        t = (step + 1).astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * gf
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(gf)
+        mhat = m / (1 - self.beta1 ** t)
+        vhat = v / (1 - self.beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self.epsilon) + \
+            self.weight_decay * p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+        u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+        trust = jnp.where(w_norm > 0, jnp.where(u_norm > 0,
+                          w_norm / u_norm, 1.0), 1.0)
+        return (p.astype(jnp.float32) - lr * trust * upd).astype(p.dtype), \
+            {"m": m, "v": v}
+
+
+class ModelAverage:
+    """optimizer.py:1471 ModelAverageOptimizer capability: maintains an EMA
+    of params for eval (apply/restore context)."""
+
+    def __init__(self, decay: float = 0.999):
+        self.decay = decay
+
+    def init(self, params: Pytree) -> Pytree:
+        return jax.tree.map(jnp.copy, params)
+
+    def update(self, avg: Pytree, params: Pytree) -> Pytree:
+        d = self.decay
+        return jax.tree.map(lambda a, p: d * a + (1 - d) * p, avg, params)

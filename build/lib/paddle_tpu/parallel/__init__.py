@@ -1,0 +1,20 @@
+from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, local_mesh
+from paddle_tpu.parallel.strategy import DistStrategy, ReduceStrategy
+from paddle_tpu.parallel.sharding import (
+    ShardingRules, named_sharding, shard_variables,
+)
+from paddle_tpu.parallel.trainer import MeshTrainer
+from paddle_tpu.parallel import collective
+from paddle_tpu.parallel.distributed import (
+    init_distributed, process_count, process_index,
+)
+from paddle_tpu.parallel.pipeline import (
+    pipeline_apply, pipeline_loss_fn, stack_stage_params,
+)
+from paddle_tpu.parallel.moe import (
+    init_moe_params, load_balancing_loss, moe_ffn, moe_partition_specs,
+)
+from paddle_tpu.parallel.ring import (
+    ring_attention, ring_flash_attention, ulysses_attention, zigzag_shard,
+    zigzag_unshard,
+)

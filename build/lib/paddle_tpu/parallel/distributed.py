@@ -1,0 +1,60 @@
+"""Multi-host bootstrap + control plane.
+
+Capability-equivalent of the reference's distributed bootstrap:
+- gen_nccl_id op (distributed_ops/gen_nccl_id_op.cc:31: rank0 creates the
+  NCCL id and RPC-broadcasts it) + ncclCommInitRank (nccl_helper.h:129)
+  → `jax.distributed.initialize(coordinator, num_processes, process_id)`:
+  one line, same capability (rendezvous + world comm over ICI/DCN).
+- the env-var contract of python/paddle/distributed/launch.py
+  (PADDLE_TRAINER_ID, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT)
+  → PTPU_COORDINATOR / PTPU_NUM_PROCESSES / PTPU_PROCESS_ID env vars, with
+  fallback to JAX's own cloud auto-detection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids: Optional[list] = None) -> None:
+    """Initialise multi-host JAX. Idempotent. Single-process if no config."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("PTPU_COORDINATOR")
+    if num_processes is None:
+        env = os.environ.get("PTPU_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("PTPU_PROCESS_ID")
+        process_id = int(env) if env else None
+    if coordinator is None and num_processes is None:
+        _initialized = True  # single-process mode
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def is_primary() -> bool:
+    """≈ trainer_id == 0 checks throughout the reference."""
+    return jax.process_index() == 0

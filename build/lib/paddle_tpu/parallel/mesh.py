@@ -1,0 +1,85 @@
+"""Device mesh construction.
+
+Capability-equivalent of the reference's device topology plumbing:
+`NCCLContextMap(places...)` (platform/nccl_helper.h:86,111) and
+ParallelExecutor's places list — on TPU the topology object is
+`jax.sharding.Mesh` with named axes, and XLA routes collectives over
+ICI/DCN automatically from shardings.
+
+Axis conventions used across the framework:
+- "dp"  data parallel (batch sharded)
+- "fsdp" param+optimizer sharded data parallel (ZeRO; reference
+  ReduceStrategy::kReduce analog, details/build_strategy.h:55)
+- "tp"  tensor parallel (features sharded)
+- "sp"  sequence/context parallel (ring attention axis)
+- "ep"  expert parallel (MoE capability extension)
+- "pp"  pipeline parallel stage axis
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Named mesh-shape spec; -1 on one axis means 'all remaining devices'.
+
+    ≈ BuildStrategy num_trainers/num_threads knobs — but declarative: the
+    user states logical parallelism, placement falls out of device order
+    (ICI-adjacent axes last so tp/sp ride the fastest links).
+    """
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    pp: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None, **axis_sizes) -> Mesh:
+    """Build a Mesh from a MeshConfig or axis_sizes kwargs.
+
+    One axis may be -1 (inferred). Axes of size 1 are kept in the mesh so
+    PartitionSpecs mentioning them always resolve — XLA drops trivial
+    dimensions at compile time.
+    """
+    if config is None:
+        config = MeshConfig(**{k: v for k, v in axis_sizes.items()})
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes()
+    unknown = [a for a, s in sizes.items() if s == -1]
+    if len(unknown) > 1:
+        raise ValueError(f"only one axis may be -1, got {unknown}")
+    known = math.prod(s for s in sizes.values() if s != -1)
+    if unknown:
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {known}")
+        sizes[unknown[0]] = len(devices) // known
+    total = math.prod(sizes.values())
+    if total != len(devices):
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {len(devices)}")
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def local_mesh(n: Optional[int] = None, axis: str = "dp") -> Mesh:
+    """Single-axis mesh over (the first n) local devices — the common
+    data-parallel case (≈ ParallelExecutor over all visible GPUs)."""
+    devices = jax.devices()[: n or len(jax.devices())]
+    return make_mesh(MeshConfig(**{axis: len(devices)}), devices=devices)

@@ -1,0 +1,116 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded over the "ep" axis.
+
+The reference has no expert parallelism (SURVEY §2.6 "not present"); this
+completes the advertised mesh axes (parallel/mesh.py "ep") with a minimal
+but real MoE layer:
+
+- E experts, each a two-matmul FFN; expert weights are stacked on a
+  leading dim sharded over `ep`, so each device holds E/ep experts.
+- Top-1 routing (Switch-style): a linear gate picks one expert per token;
+  outputs are scaled by the gate probability so the router receives
+  gradient signal.
+- Dispatch is SPMD-uniform masked compute + one psum: every device runs
+  its local experts over the full token set with non-owned tokens zeroed,
+  and the cross-device combine is a single psum over ICI (the same
+  masked-gather+psum pattern as parallel.embedding.ShardedEmbedding).
+  An all_to_all token-dropping dispatch is the known optimisation for
+  large E; the masked form is exact (no dropped tokens) and keeps the
+  program shape static.
+- load_balancing_loss implements the standard Switch auxiliary loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+Pytree = Any
+
+
+def init_moe_params(rng, num_experts: int, d_model: int, d_hidden: int,
+                    dtype=jnp.float32) -> Dict[str, jax.Array]:
+    """Stacked expert weights (leading dim = experts; shard over "ep")."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1 = (2.0 / d_model) ** 0.5
+    s2 = (2.0 / d_hidden) ** 0.5
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts), dtype) * s1,
+        "w1": jax.random.normal(
+            k2, (num_experts, d_model, d_hidden), dtype) * s1,
+        "w2": jax.random.normal(
+            k3, (num_experts, d_hidden, d_model), dtype) * s2,
+    }
+
+
+def moe_partition_specs() -> Dict[str, P]:
+    """PartitionSpecs for init_moe_params output (experts over "ep")."""
+    return {"gate": P(), "w1": P("ep", None, None), "w2": P("ep", None, None)}
+
+
+def _expert_ffn(w1, w2, x):
+    return jax.nn.relu(x @ w1) @ w2
+
+
+def moe_ffn(params: Dict[str, jax.Array], x: jax.Array,
+            mesh: Optional[Mesh] = None, axis: str = "ep"
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-1 MoE FFN. x: [tokens, D] -> (y [tokens, D], aux).
+
+    aux carries `router_probs` [tokens, E] and `expert_index` [tokens]
+    for the load-balancing loss. With `mesh`, expert compute runs under
+    shard_map with experts sharded over `axis`; without, a dense vmap
+    (single-device / XLA-partitioned path).
+    """
+    e = params["w1"].shape[0]
+    logits = x @ params["gate"].astype(x.dtype)           # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)                      # [T]
+    top_p = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(idx, e, dtype=x.dtype)        # [T, E]
+
+    if mesh is not None and mesh.shape[axis] > 1:
+        n = mesh.shape[axis]
+        per = e // n
+
+        def local(w1_l, w2_l, x_full, onehot_full):
+            # w1_l/w2_l: [E/ep, ...] local experts; masked compute + psum
+            first = lax.axis_index(axis) * per
+            y = jnp.zeros_like(x_full)
+            for j in range(per):                     # static tiny loop
+                sel = onehot_full[:, first + j][:, None]
+                y = y + sel * _expert_ffn(w1_l[j], w2_l[j],
+                                          x_full * sel)
+            return lax.psum(y, axis)
+
+        y = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None, None), P(), P()),
+            out_specs=P(), check_vma=False)(
+                params["w1"].astype(x.dtype), params["w2"].astype(x.dtype),
+                x, onehot)
+    else:
+        def one_expert(w1, w2, sel):
+            return _expert_ffn(w1, w2, x * sel[:, None]) * sel[:, None]
+        ys = jax.vmap(one_expert, in_axes=(0, 0, 1))(
+            params["w1"].astype(x.dtype), params["w2"].astype(x.dtype),
+            onehot)
+        y = jnp.sum(ys, axis=0)
+
+    y = y * top_p[:, None].astype(y.dtype)                # router gets grads
+    return y, {"router_probs": probs, "expert_index": idx}
+
+
+def load_balancing_loss(aux: Dict[str, jax.Array]) -> jax.Array:
+    """Switch-transformer auxiliary loss: E * sum_e f_e * P_e, where f_e =
+    fraction of tokens routed to e, P_e = mean router prob of e. Minimised
+    (=1) at uniform routing."""
+    probs = aux["router_probs"]                           # [T, E]
+    e = probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(aux["expert_index"], e), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)

@@ -1,0 +1,106 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the "pp" axis.
+
+The reference has no pipeline parallelism (SURVEY §2.6 "not present");
+this is a TPU-native extension completing the advertised mesh axes
+(parallel/mesh.py "pp"). Design follows the SPMD pipeline idiom:
+
+- The model is S identical-shape stages. Per-stage parameters are stacked
+  on a leading dim sharded over the pp axis, so each device holds exactly
+  its own stage's weights (the shard_map body sees a [1, ...] slice).
+- Microbatches stream through a lax.scan over M + S - 1 ticks. At tick t,
+  stage s computes microbatch (t - s); activations hop one stage per tick
+  via a single `ppermute` over ICI. Bubble fraction is the standard
+  (S - 1) / (M + S - 1).
+- Backward needs no hand-written schedule: `ppermute` is linear, its
+  transpose is the reverse rotation, so jax.grad through pipeline_apply
+  yields the mirrored backward pipeline automatically — the compiler owns
+  the schedule, exactly the XLA-first stance of this framework.
+
+All devices run the same program on identically-shaped data (masked when
+idle) — SPMD-uniform, no per-stage programs to compile.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Pytree = Any
+
+
+def stack_stage_params(per_stage: Sequence[Pytree]) -> Pytree:
+    """Stack a list of per-stage param pytrees on a new leading axis
+    (shard it over "pp" via P("pp", ...))."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
+
+
+def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+                   stacked_params: Pytree, microbatches: jax.Array,
+                   mesh: Mesh, axis: str = "pp"):
+    """Run S pipeline stages over M microbatches.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (equal-width stages —
+    the usual transformer-block case). stacked_params: leading dim S
+    sharded over `axis`. microbatches: [M, mb, ...] (replicated input).
+    Returns [M, mb, ...] outputs (replicated), differentiable end to end.
+    """
+    s = mesh.shape[axis]
+    m = microbatches.shape[0]
+    if m < 1:
+        raise ValueError("need at least one microbatch")
+
+    def local(params, xs):
+        # params: [1, ...] this stage's slice; xs: full [M, mb, ...]
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        total = m + s - 1
+        fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+        zero = jnp.zeros_like(xs[0])
+
+        def tick(carry, t):
+            buf = carry                       # activation arriving this tick
+            # stage 0 ingests microbatch t (while t < m); later stages use
+            # the rotated buffer
+            x_in = jnp.where(t < m, xs[jnp.minimum(t, m - 1)], zero)
+            x_t = jnp.where(stage == 0, x_in, buf)
+            y = stage_fn(params, x_t)
+            # the last stage's result for microbatch (t - (s-1)) is ready
+            out_t = jnp.where(stage == s - 1, y, jnp.zeros_like(y))
+            y_next = lax.ppermute(y, axis, fwd_perm)
+            return y_next, out_t
+
+        _, outs = lax.scan(tick, zero, jnp.arange(total))
+        # outs[t] is valid on the last stage for t in [s-1, total);
+        # every other stage contributed zeros -> one psum replicates the
+        # last stage's outputs everywhere.
+        outs = lax.psum(outs[s - 1:], axis)
+        return outs
+
+    in_specs = (P(axis), P())          # params sharded by stage, xs replic.
+    out_specs = P()
+    return jax.shard_map(partial(local), mesh=mesh,
+                         in_specs=in_specs, out_specs=out_specs,
+                         check_vma=False)(stacked_params, microbatches)
+
+
+def pipeline_loss_fn(stage_fn: Callable, loss_of_outputs: Callable,
+                     mesh: Mesh, axis: str = "pp",
+                     num_microbatches: Optional[int] = None):
+    """Build a MeshTrainer-compatible capability: params -> scalar loss.
+
+    Returns fn(stacked_params, batch_x, batch_y) that splits the batch
+    into microbatches, pipelines the forward, and averages
+    loss_of_outputs(y_pred, y_true) over microbatches.
+    """
+    def fn(stacked_params, x, y):
+        mb = num_microbatches or mesh.shape[axis]
+        xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
+        ys = y.reshape((mb, y.shape[0] // mb) + y.shape[1:])
+        outs = pipeline_apply(stage_fn, stacked_params, xs, mesh, axis)
+        return jnp.mean(jax.vmap(loss_of_outputs)(outs, ys))
+    return fn

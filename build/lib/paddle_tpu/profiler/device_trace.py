@@ -1,0 +1,121 @@
+"""Device-tier op-time tables from jax.profiler traces.
+
+The reference's device tier is CUPTI records aggregated into op-time
+tables (platform/device_tracer.h:39, EnableProfiler/DisableProfiler
+printing sorted tables; tools/timeline.py converting to Chrome format).
+The TPU analog: jax.profiler.start_trace writes a perfetto/Chrome trace
+with one event per executed HLO op carrying `hlo_category`,
+`bytes_accessed` and `model_flops` — this module parses that file and
+aggregates it into the same kind of table, which is exactly the workflow
+that found this framework's round-3 bottlenecks (norm-layer fp32 traffic,
+fp32 flash matmuls, log-softmax materialization).
+
+Usage:
+    with device_trace("/tmp/trace"):
+        for _ in range(5):
+            state, out = trainer.train_step(state, batch)
+        jax.block_until_ready(out["loss"])
+    table = op_table("/tmp/trace", steps=5)
+    print(format_table(table))
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import glob
+import gzip
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a device trace around a block (jax.profiler.trace with the
+    start/stop pair the reference exposes as EnableProfiler/Disable)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class OpRow:
+    name: str                 # hlo op / fusion name or category
+    total_ms: float           # device time over the captured window
+    count: int
+    bytes_accessed: int
+    flops: int
+
+    @property
+    def gbps(self) -> float:
+        return (self.bytes_accessed / (self.total_ms / 1e3) / 1e9
+                if self.total_ms else 0.0)
+
+    @property
+    def tflops(self) -> float:
+        return (self.flops / (self.total_ms / 1e3) / 1e12
+                if self.total_ms else 0.0)
+
+
+def _load_events(log_dir: str) -> List[dict]:
+    """Events from EVERY trace file under log_dir (multi-host captures
+    write one per host; aggregating only one would silently understate an
+    N-host job by ~N×)."""
+    paths = sorted(glob.glob(f"{log_dir}/**/*.trace.json.gz",
+                             recursive=True))
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {log_dir}")
+    events: List[dict] = []
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        events.extend(
+            ev for ev in data.get("traceEvents", [])
+            if ev.get("ph") == "X" and "hlo_category" in ev.get("args", {}))
+    return events
+
+
+def op_table(log_dir: str, by: str = "category", steps: int = 1,
+             top: Optional[int] = None) -> List[OpRow]:
+    """Aggregate device op time. by="category" groups by hlo_category
+    (convolution fusion / loop fusion / copy ...); by="op" keeps
+    individual fusion names. Durations are divided by `steps` to report
+    per-step numbers. Sorted by time, descending."""
+    events = _load_events(log_dir)
+    dur = collections.Counter()
+    cnt = collections.Counter()
+    byt = collections.Counter()
+    flp = collections.Counter()
+    for ev in events:
+        a = ev["args"]
+        key = a["hlo_category"] if by == "category" else ev["name"]
+        dur[key] += ev["dur"]
+        cnt[key] += 1
+        byt[key] += int(a.get("bytes_accessed", 0) or 0)
+        flp[key] += int(a.get("model_flops", 0) or 0)
+    rows = [OpRow(name=k, total_ms=dur[k] / 1e3 / steps,
+                  count=max(cnt[k] // steps, 1),
+                  bytes_accessed=byt[k] // steps,
+                  flops=flp[k] // steps)
+            for k in dur]
+    rows.sort(key=lambda r: -r.total_ms)
+    return rows[:top] if top else rows
+
+
+def format_table(rows: List[OpRow]) -> str:
+    """EnableProfiler-style sorted table."""
+    total = sum(r.total_ms for r in rows) or 1e-12
+    lines = [f"{'ms/step':>9} {'%':>6} {'calls':>6} {'GB/s':>8} "
+             f"{'TF/s':>7}  name",
+             "-" * 72]
+    for r in rows:
+        lines.append(f"{r.total_ms:9.3f} {100 * r.total_ms / total:6.1f} "
+                     f"{r.count:6d} {r.gbps:8.1f} {r.tflops:7.2f}  "
+                     f"{r.name[:40]}")
+    lines.append(f"{total:9.3f}  total device time")
+    return "\n".join(lines)

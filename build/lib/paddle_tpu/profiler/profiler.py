@@ -1,0 +1,212 @@
+"""Event profiler + device-trace wrappers.
+
+Host tier ≈ reference RecordEvent/EnableProfiler
+(/root/reference/paddle/fluid/platform/profiler.h:72,117-126; tables
+printed by DisableProfiler with a sort key). Device tier wraps
+jax.profiler (≈ CUPTI device tracer, platform/device_tracer.h:39) — the
+captured trace dir is TensorBoard/perfetto-loadable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from paddle_tpu.utils.log import vlog
+
+_lock = threading.Lock()
+_events: List[dict] = []          # completed spans: name/ts/dur/tid (us)
+_enabled = False
+_trace_dir: Optional[str] = None
+# Wall-clock anchor for the monotonic counter: timestamps are epoch-based
+# microseconds so profiles from different processes merge on a common
+# timeline (tools/timeline.py multi-trainer merge needs comparable ts).
+_EPOCH_NS = time.time_ns() - time.perf_counter_ns()
+
+
+def _now_us() -> float:
+    return (_EPOCH_NS + time.perf_counter_ns()) / 1e3
+
+
+class RecordEvent:
+    """RAII host-side span (≈ platform/profiler.h:72 RecordEvent).
+
+    Usable as a context manager. Spans are recorded only while the
+    profiler is enabled (between start_profiler and stop_profiler) —
+    matching the reference's g_state gate.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = 0.0
+
+    def __enter__(self):
+        self._start = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:
+            return False
+        end = _now_us()
+        with _lock:
+            _events.append({
+                "name": self.name,
+                "ts": self._start,
+                "dur": end - self._start,
+                "tid": threading.get_ident() & 0xFFFF,
+            })
+        return False
+
+
+record_event = RecordEvent
+
+
+def record_function(name: Optional[str] = None):
+    """Decorator wrapping a function body in a RecordEvent span."""
+
+    def deco(fn):
+        ev_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with RecordEvent(ev_name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region in the DEVICE trace (jax.profiler.TraceAnnotation) and
+    the host event list — the named_scope analog of the reference's
+    RecordEvent-around-kernel-launch."""
+    with jax.profiler.TraceAnnotation(name), RecordEvent(name):
+        yield
+
+
+def start_profiler(trace_dir: Optional[str] = None) -> None:
+    """Enable host-span recording; if trace_dir is given, also start a
+    jax.profiler device trace into it (≈ EnableProfiler(kAll))."""
+    global _enabled, _trace_dir
+    with _lock:
+        _events.clear()
+    _enabled = True
+    _trace_dir = trace_dir
+    if trace_dir:
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+    vlog(1, f"profiler started (trace_dir={trace_dir})")
+
+
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: Optional[str] = None,
+                  print_table: bool = True) -> List[dict]:
+    """Stop profiling; print the aggregated op-time table and optionally
+    dump the raw events as a Chrome-trace json (≈ DisableProfiler's
+    sorted table + profiler.proto dump, profiler.h:117-126).
+
+    sorted_key in {"total", "calls", "max", "min", "ave"}.
+    Returns the aggregated rows.
+    """
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir:
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    rows = profile_table(sorted_key)
+    if print_table and rows:
+        print(format_table(rows, sorted_key))
+    if profile_path:
+        save_profile(profile_path)
+    return rows
+
+
+def reset_profiler() -> None:
+    with _lock:
+        _events.clear()
+
+
+def get_events() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+@contextlib.contextmanager
+def profiler(sorted_key: str = "total", profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """Context manager form (≈ fluid.profiler.profiler)."""
+    start_profiler(trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key=sorted_key, profile_path=profile_path)
+
+
+def profile_table(sorted_key: str = "total") -> List[dict]:
+    """Aggregate recorded spans into per-name stats rows."""
+    agg: Dict[str, dict] = {}
+    for ev in get_events():
+        row = agg.setdefault(ev["name"], {
+            "name": ev["name"], "calls": 0, "total": 0.0,
+            "min": float("inf"), "max": 0.0,
+        })
+        row["calls"] += 1
+        row["total"] += ev["dur"]
+        row["min"] = min(row["min"], ev["dur"])
+        row["max"] = max(row["max"], ev["dur"])
+    rows = []
+    grand_total = sum(r["total"] for r in agg.values()) or 1.0
+    for row in agg.values():
+        row["ave"] = row["total"] / row["calls"]
+        row["ratio"] = row["total"] / grand_total
+        rows.append(row)
+    key = {"total": "total", "calls": "calls", "max": "max", "min": "min",
+           "ave": "ave"}.get(sorted_key, "total")
+    rows.sort(key=lambda r: r[key], reverse=True)
+    return rows
+
+
+def format_table(rows: List[dict], sorted_key: str = "total") -> str:
+    lines = [
+        f"------------------->  Profiling Report (sorted by {sorted_key})"
+        "  <-------------------",
+        f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Min(us)':>12}"
+        f"{'Max(us)':>12}{'Ave(us)':>12}{'Ratio':>8}",
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['name'][:39]:<40}{r['calls']:>8}{r['total']:>14.1f}"
+            f"{r['min']:>12.1f}{r['max']:>12.1f}{r['ave']:>12.1f}"
+            f"{r['ratio']:>8.3f}")
+    return "\n".join(lines)
+
+
+def events_to_chrome_trace(events: Optional[List[dict]] = None,
+                           pid: int = 0) -> dict:
+    """Render host spans as Chrome trace format (chrome://tracing /
+    perfetto), ≈ tools/timeline.py:36 _ChromeTraceFormatter."""
+    events = get_events() if events is None else events
+    trace = [{
+        "name": ev["name"], "ph": "X", "cat": "host",
+        "ts": ev["ts"], "dur": ev["dur"], "pid": pid, "tid": ev["tid"],
+        "args": {},
+    } for ev in events]
+    meta = [{"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": f"process {pid}"}}]
+    return {"traceEvents": meta + trace, "displayTimeUnit": "ms"}
+
+
+def save_profile(path: str, pid: int = 0) -> None:
+    """Dump recorded host events as a Chrome-trace json file."""
+    with open(path, "w") as f:
+        json.dump(events_to_chrome_trace(pid=pid), f)
+    vlog(1, f"profile written to {path}")

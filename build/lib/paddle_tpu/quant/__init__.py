@@ -1,0 +1,23 @@
+"""Quantization / model-compression capability (reference contrib.slim:
+quantization_pass.py QAT transform + freeze, contrib/int8_inference PTQ).
+"""
+
+from paddle_tpu.quant.fake_quant import (
+    dequantize, fake_quant_abs_max, fake_quant_channel_abs_max,
+    fake_quant_moving_average, int8_matmul, qrange, quantize)
+from paddle_tpu.quant.layers import QuantConv2D, QuantLinear, quantize_model
+from paddle_tpu.quant.ptq import (
+    calibrate, dequantize_weights, quantize_weights, quantized_nbytes)
+from paddle_tpu.quant.prune import (
+    apply_masks, magnitude_masks, masked_train_step, select_ratios,
+    sensitivity_analysis, sparsity)
+
+__all__ = [
+    "dequantize", "fake_quant_abs_max", "fake_quant_channel_abs_max",
+    "fake_quant_moving_average", "int8_matmul", "qrange", "quantize",
+    "QuantConv2D", "QuantLinear", "quantize_model",
+    "calibrate", "dequantize_weights", "quantize_weights",
+    "quantized_nbytes",
+    "apply_masks", "magnitude_masks", "masked_train_step",
+    "select_ratios", "sensitivity_analysis", "sparsity",
+]

@@ -1,0 +1,99 @@
+"""Fake-quantization ops (QAT) and real int8 compute.
+
+Capability-equivalent of the reference slim/quantization stack:
+- fake_quantize_abs_max / fake_quantize_moving_average_abs_max /
+  fake_channel_wise_quantize_abs_max ops
+  (/root/reference/python/paddle/fluid/contrib/slim/quantization/
+  quantization_pass.py:283-344 inserts them; operators/fake_quantize_op.cc
+  implements them);
+- the straight-through estimator those ops rely on (grad of round == 1).
+
+TPU note: int8 matmul rides the MXU at 2x bf16 peak — `int8_matmul` is the
+real-quantized execution path (the reference's int8 inference capability,
+contrib/int8_inference/), accumulating in int32 via preferred_element_type.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def qrange(bits: int) -> float:
+    """Symmetric quantization range: [-2^(b-1)+1, 2^(b-1)-1] (the
+    reference's bnt = (1 << (bits - 1)) - 1)."""
+    return float((1 << (bits - 1)) - 1)
+
+
+def quantize(x, scale, bits: int = 8):
+    """Real quantization to integers (round-to-nearest, clamped)."""
+    r = qrange(bits)
+    q = jnp.round(x / jnp.maximum(scale, 1e-12) * r)
+    return jnp.clip(q, -r, r)
+
+
+def dequantize(q, scale, bits: int = 8):
+    return q.astype(jnp.float32) * scale / qrange(bits)
+
+
+def _ste(x, qdq):
+    """Straight-through estimator: forward qdq(x), gradient of identity."""
+    return x + lax.stop_gradient(qdq - x)
+
+
+def abs_max_scale(x, axis=None, keepdims: bool = False):
+    return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims)
+
+
+def fake_quant_abs_max(x, bits: int = 8):
+    """Per-tensor fake quant with the current abs-max as scale
+    (fake_quantize_abs_max op). Differentiable via STE."""
+    scale = lax.stop_gradient(abs_max_scale(x))
+    qdq = dequantize(quantize(x, scale, bits), scale, bits)
+    return _ste(x, qdq), scale
+
+
+def fake_quant_channel_abs_max(w, bits: int = 8, axis: int = -1):
+    """Per-output-channel weight fake quant
+    (fake_channel_wise_quantize_abs_max op). `axis` is the output-channel
+    dim of the weight (last for both [in, out] dense and HWIO conv)."""
+    red = tuple(i for i in range(w.ndim) if i != axis % w.ndim)
+    scale = lax.stop_gradient(abs_max_scale(w, axis=red, keepdims=True))
+    qdq = dequantize(quantize(w, scale, bits), scale, bits)
+    return _ste(w, qdq), jnp.squeeze(scale)
+
+
+def fake_quant_moving_average(x, running_scale, bits: int = 8,
+                              momentum: float = 0.9,
+                              update: bool = True):
+    """Activation fake quant with an EMA abs-max scale
+    (fake_quantize_moving_average_abs_max op). Returns (qdq_x, new_scale);
+    pass update=False at inference to freeze the scale."""
+    cur = lax.stop_gradient(abs_max_scale(x))
+    if update:
+        new_scale = jnp.where(running_scale > 0,
+                              momentum * running_scale
+                              + (1.0 - momentum) * cur,
+                              cur)
+    else:
+        new_scale = running_scale
+    use = lax.stop_gradient(jnp.where(new_scale > 0, new_scale, cur))
+    qdq = dequantize(quantize(x, use, bits), use, bits)
+    return _ste(x, qdq), new_scale
+
+
+def int8_matmul(x, w, x_scale, w_scale, bits: int = 8):
+    """Real int8 x int8 -> int32 matmul with f32 rescale (the int8
+    inference execution tier; MXU int8 path via preferred_element_type).
+
+    x [..., K] f32, w [K, N] f32; scales per-tensor (x) and per-channel
+    [N] or scalar (w)."""
+    r = qrange(bits)
+    qx = quantize(x, x_scale, bits).astype(jnp.int8)
+    qw = quantize(w, w_scale, bits).astype(jnp.int8)
+    acc = lax.dot_general(qx, qw, (((qx.ndim - 1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (x_scale * w_scale) / (r * r)

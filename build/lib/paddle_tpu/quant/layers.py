@@ -1,0 +1,145 @@
+"""QAT layers + model rewriter.
+
+Capability-equivalent of the reference QuantizationTransformPass
+(/root/reference/python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:116 `apply`: walks the graph, replaces every
+quantizable op's inputs with fake-quant/dequant pairs). Here the "graph"
+is the module tree, so the pass is `quantize_model`: it swaps each
+Linear/Conv2D for its Quant* twin in place. Parameter names are
+unchanged, so an FP32 pretrained checkpoint loads directly into the
+quantized model (the reference's scale_dict/init-from-checkpoint flow);
+only the activation-scale EMA is new state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.module import Context, Module
+from paddle_tpu.nn import initializers as I
+from paddle_tpu.nn.layers import Conv2D, Linear
+from paddle_tpu.quant.fake_quant import (
+    fake_quant_channel_abs_max, fake_quant_moving_average)
+
+
+class QuantLinear(Linear):
+    """Linear with per-channel weight fake-quant + EMA activation
+    fake-quant (QAT). Same param names as Linear."""
+
+    def __init__(self, *args, weight_bits: int = 8, act_bits: int = 8,
+                 momentum: float = 0.9, **kw):
+        super().__init__(*args, **kw)
+        self.weight_bits = weight_bits
+        self.act_bits = act_bits
+        self.momentum = momentum
+
+    @classmethod
+    def from_float(cls, lin: Linear, weight_bits: int = 8,
+                   act_bits: int = 8) -> "QuantLinear":
+        q = cls(lin.features, use_bias=lin.use_bias,
+                kernel_init=lin.kernel_init, bias_init=lin.bias_init,
+                dtype=lin.dtype, param_dtype=lin.param_dtype,
+                weight_bits=weight_bits, act_bits=act_bits)
+        object.__setattr__(q, "_name", lin._name)
+        return q
+
+    def forward(self, cx: Context, x):
+        in_features = x.shape[-1]
+        w = cx.param("weight", (in_features, self.features),
+                     self.kernel_init, self.param_dtype)
+        scale = cx.state("act_scale", (), I.zeros)
+        xq, new_scale = fake_quant_moving_average(
+            x.astype(jnp.float32), scale, self.act_bits,
+            self.momentum, update=cx.training)
+        if cx.training:
+            cx.set_state("act_scale", new_scale)
+        wq, _ = fake_quant_channel_abs_max(w.astype(jnp.float32),
+                                           self.weight_bits, axis=-1)
+        y = jnp.matmul(xq.astype(self.dtype), wq.astype(self.dtype))
+        if self.use_bias:
+            b = cx.param("bias", (self.features,), self.bias_init,
+                         self.param_dtype)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+class QuantConv2D(Conv2D):
+    """Conv2D with per-channel weight fake-quant + EMA activation
+    fake-quant (QAT). Same param names as Conv2D."""
+
+    def __init__(self, *args, weight_bits: int = 8, act_bits: int = 8,
+                 momentum: float = 0.9, **kw):
+        super().__init__(*args, **kw)
+        self.weight_bits = weight_bits
+        self.act_bits = act_bits
+        self.momentum = momentum
+
+    @classmethod
+    def from_float(cls, conv: Conv2D, weight_bits: int = 8,
+                   act_bits: int = 8) -> "QuantConv2D":
+        q = cls(conv.features, conv.kernel_size, stride=conv.stride,
+                padding=conv.padding, dilation=conv.dilation,
+                groups=conv.groups, use_bias=conv.use_bias,
+                kernel_init=conv.kernel_init, bias_init=conv.bias_init,
+                dtype=conv.dtype, param_dtype=conv.param_dtype,
+                weight_bits=weight_bits, act_bits=act_bits)
+        object.__setattr__(q, "_name", conv._name)
+        return q
+
+    def forward(self, cx: Context, x):
+        cin = x.shape[-1]
+        kh, kw = self.kernel_size
+        w = cx.param("weight", (kh, kw, cin // self.groups, self.features),
+                     self.kernel_init, self.param_dtype)
+        scale = cx.state("act_scale", (), I.zeros)
+        xq, new_scale = fake_quant_moving_average(
+            x.astype(jnp.float32), scale, self.act_bits,
+            self.momentum, update=cx.training)
+        if cx.training:
+            cx.set_state("act_scale", new_scale)
+        wq, _ = fake_quant_channel_abs_max(w.astype(jnp.float32),
+                                           self.weight_bits, axis=-1)
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad), (pad, pad)]
+        elif isinstance(pad, (tuple, list)) and isinstance(pad[0], int):
+            pad = [(pad[0], pad[0]), (pad[1], pad[1])]
+        y = lax.conv_general_dilated(
+            xq.astype(self.dtype), wq.astype(self.dtype),
+            window_strides=self.stride, padding=pad,
+            rhs_dilation=self.dilation, feature_group_count=self.groups,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            b = cx.param("bias", (self.features,), self.bias_init,
+                         self.param_dtype)
+            y = y + b.astype(self.dtype)
+        return y
+
+
+def _convert(m: Module, weight_bits: int, act_bits: int) -> Module:
+    if type(m) is Linear:
+        return QuantLinear.from_float(m, weight_bits, act_bits)
+    if type(m) is Conv2D:
+        return QuantConv2D.from_float(m, weight_bits, act_bits)
+    quantize_model(m, weight_bits, act_bits)
+    return m
+
+
+def quantize_model(module: Module, weight_bits: int = 8,
+                   act_bits: int = 8) -> Module:
+    """In-place QAT rewrite of a module tree (QuantizationTransformPass
+    capability): every Linear/Conv2D becomes its Quant* twin; other
+    modules are recursed into. Returns the same (mutated) module."""
+    for attr, value in list(vars(module).items()):
+        if attr in ("_children", "_name"):
+            continue
+        if isinstance(value, Module):
+            setattr(module, attr, _convert(value, weight_bits, act_bits))
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Module) for v in value):
+            newl = [_convert(v, weight_bits, act_bits) for v in value]
+            setattr(module, attr, type(value)(newl))
+    return module

@@ -1,0 +1,147 @@
+"""Model pruning — the contrib.slim prune capability.
+
+Reference: /root/reference/python/paddle/fluid/contrib/slim/prune/
+prune_strategy.py (SensitivePruneStrategy: per-layer ratios from loss
+sensitivity; magnitude pruning of conv/fc weights) and
+slim/core/compress_pass.py (the strategy-driven compression loop).
+
+TPU-first design: pruning is a pytree-of-masks transform, not a graph
+pass. Masks are computed from trained parameters (global or per-layer
+magnitude), applied functionally (params * mask) — so a pruned model runs
+through the SAME jitted step, and masks can be baked in at export. The
+sensitivity analysis evaluates the user's loss at several candidate
+ratios per layer, mirroring SensitivePruneStrategy's search.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def _prunable(path: str, leaf, pattern: str) -> bool:
+    return (re.search(pattern, path) is not None
+            and getattr(leaf, "ndim", 0) >= 2)
+
+
+def _paths(tree: Pytree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(str(getattr(p, "key", p)) for p in path), leaf)
+            for path, leaf in flat]
+
+
+def magnitude_masks(params: Pytree, ratio,
+                    pattern: str = r"weight$",
+                    granularity: str = "element") -> Pytree:
+    """Binary keep-masks by weight magnitude.
+
+    ratio: float (same sparsity everywhere) or {path-regex: float}.
+    granularity: "element" (unstructured) or "channel" (structured — whole
+    output channels by their L2 norm, the filter-pruning mode of the
+    reference's prune strategies).
+    Non-prunable leaves get all-ones masks.
+    """
+    def ratio_for(path):
+        if isinstance(ratio, dict):
+            for pat, r in ratio.items():
+                if re.fullmatch(pat, path):
+                    return r
+            return 0.0
+        return ratio
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    masks = []
+    for path_keys, leaf in flat:
+        path = "/".join(str(getattr(p, "key", p)) for p in path_keys)
+        r = ratio_for(path)
+        if not _prunable(path, leaf, pattern) or r <= 0.0:
+            masks.append(jnp.ones_like(leaf, dtype=jnp.float32))
+            continue
+        if granularity == "channel":
+            # output channels live on the last dim for both Linear
+            # (in, out) and Conv (kh, kw, in, out)
+            norms = jnp.sqrt(jnp.sum(
+                jnp.square(leaf.astype(jnp.float32)),
+                axis=tuple(range(leaf.ndim - 1))))
+            k = int(norms.shape[0] * (1.0 - r))
+            k = max(k, 1)
+            thresh = jnp.sort(norms)[-k]
+            keep = (norms >= thresh).astype(jnp.float32)
+            masks.append(jnp.broadcast_to(keep, leaf.shape))
+        else:
+            mag = jnp.abs(leaf.astype(jnp.float32)).reshape(-1)
+            k = int(mag.size * (1.0 - r))
+            k = max(k, 1)
+            thresh = jnp.sort(mag)[-k]
+            masks.append((jnp.abs(leaf.astype(jnp.float32)) >= thresh)
+                         .astype(jnp.float32).reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def apply_masks(params: Pytree, masks: Pytree) -> Pytree:
+    """params * mask, preserving dtypes (the functional prune)."""
+    return jax.tree.map(lambda p, m: (p * m.astype(p.dtype)), params, masks)
+
+
+def sparsity(masks: Pytree, pattern: str = r"weight$") -> float:
+    """Achieved sparsity over prunable leaves."""
+    total = kept = 0
+    for path, m in _paths(masks):
+        if re.search(pattern, path) and getattr(m, "ndim", 0) >= 2:
+            total += m.size
+            kept += float(jnp.sum(m))
+    return 1.0 - kept / total if total else 0.0
+
+
+def masked_train_step(trainer, masks: Pytree):
+    """Wrap trainer.train_step so gradients of pruned weights stay pruned
+    (the fine-tune-after-prune loop of compress_pass.py). Returns a
+    step(ts, batch, rng) callable."""
+    def step(ts, batch, rng=None):
+        new_ts, fetches = trainer.train_step(ts, batch, rng=rng)
+        masked = type(new_ts)(apply_masks(new_ts.params, masks),
+                              new_ts.state, new_ts.opt_state, new_ts.step)
+        return masked, fetches
+    return step
+
+
+def sensitivity_analysis(eval_loss: Callable[[Pytree], float],
+                         params: Pytree,
+                         ratios: Sequence[float] = (0.3, 0.5, 0.7, 0.9),
+                         pattern: str = r"weight$") -> Dict[str, Dict]:
+    """Per-layer loss sensitivity (SensitivePruneStrategy.metric search):
+    for each prunable leaf, prune ONLY it at each ratio and record the
+    eval loss. Returns {path: {ratio: loss}}."""
+    base = float(eval_loss(params))
+    out: Dict[str, Dict] = {}
+    for path, leaf in _paths(params):
+        if not _prunable(path, leaf, pattern):
+            continue
+        per = {0.0: base}
+        for r in ratios:
+            masks = magnitude_masks(params, {re.escape(path): r},
+                                    pattern=pattern)
+            per[float(r)] = float(eval_loss(apply_masks(params, masks)))
+        out[path] = per
+    return out
+
+
+def select_ratios(sens: Dict[str, Dict], budget: float) -> Dict[str, float]:
+    """Pick per-layer ratios: the largest ratio whose loss increase stays
+    within `budget` over the unpruned loss (greedy per layer, the
+    sensitivity-threshold rule of the reference strategy)."""
+    chosen = {}
+    for path, per in sens.items():
+        base = per[0.0]
+        best = 0.0
+        for r, loss in sorted(per.items()):
+            if r > 0 and loss <= base + budget:
+                best = max(best, r)
+        chosen[re.escape(path)] = best
+    return chosen

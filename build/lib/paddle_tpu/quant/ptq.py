@@ -1,0 +1,96 @@
+"""Post-training quantization: calibration + int8 weight storage.
+
+Capability-equivalent of the reference PTQ/int8 flow (contrib/
+int8_inference/, slim QuantizationFreezePass quantization_pass.py:415:
+round weights to int8 using collected scales, keep scales for dequant).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Module, STATE, Variables
+from paddle_tpu.quant.fake_quant import dequantize, quantize
+from paddle_tpu.quant.layers import quantize_model
+
+
+def calibrate(module: Module, variables: Variables,
+              batches: Iterable[Any], weight_bits: int = 8,
+              act_bits: int = 8) -> Tuple[Module, Variables]:
+    """PTQ calibration: rewrite to QAT layers, then run forward over
+    calibration batches in training mode (no optimizer) so the EMA
+    activation scales fill in (the reference's sample-and-collect-scales
+    pass). Returns (quantized module, variables incl. frozen scales)."""
+    qmodule = quantize_model(module, weight_bits, act_bits)
+    # materialise the new act_scale state entries
+    first = True
+    for batch in batches:
+        args = batch if isinstance(batch, (tuple, list)) else (batch,)
+        if first:
+            init_vars = qmodule.init(0, *args, training=True)
+            variables = {**variables,
+                         STATE: _merge(init_vars.get(STATE, {}),
+                                       variables.get(STATE, {}))}
+            first = False
+        _, mut = qmodule.apply(variables, *args, training=True,
+                               rngs=jax.random.key(0), mutable=True)
+        variables = {**variables, STATE: mut[STATE]}
+    if first:
+        raise ValueError(
+            "calibrate() got no calibration batches — activation scales "
+            "cannot be collected from an empty iterable")
+    return qmodule, variables
+
+
+def _merge(base: Dict, override: Dict) -> Dict:
+    out = dict(base)
+    for k, v in override.items():
+        out[k] = (_merge(base.get(k, {}), v)
+                  if isinstance(v, dict) and isinstance(base.get(k), dict)
+                  else v)
+    return out
+
+
+def quantize_weights(params, bits: int = 8,
+                     pattern: str = r"(weight|kernel)$"):
+    """Freeze weights to int8 storage (QuantizationFreezePass capability):
+    per-output-channel abs-max scales, int8 arrays. Returns
+    (quantized params pytree with int8 leaves where matched, scales
+    pytree with per-channel f32 scales or None)."""
+    rx = re.compile(pattern)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    q_leaves, s_leaves = [], []
+    for path, leaf in flat:
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        if rx.search(name) and leaf.ndim >= 2:
+            red = tuple(range(leaf.ndim - 1))
+            scale = jnp.max(jnp.abs(leaf), axis=red)      # per out-channel
+            scale = jnp.maximum(scale, 1e-12)
+            q = quantize(leaf, scale, bits).astype(jnp.int8)
+            q_leaves.append(q)
+            s_leaves.append(scale)
+        else:
+            q_leaves.append(leaf)
+            s_leaves.append(None)
+    return (jax.tree_util.tree_unflatten(treedef, q_leaves),
+            jax.tree_util.tree_unflatten(
+                treedef, [s if s is not None else 0.0 for s in s_leaves]))
+
+
+def dequantize_weights(qparams, scales, bits: int = 8):
+    """Inverse of quantize_weights (int8 storage -> f32 compute)."""
+    def deq(q, s):
+        if q.dtype == jnp.int8:
+            return dequantize(q.astype(jnp.float32), s, bits)
+        return q
+    return jax.tree_util.tree_map(deq, qparams, scales)
+
+
+def quantized_nbytes(params) -> int:
+    return sum(np.asarray(l).nbytes
+               for l in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,23 @@
+"""RecordIO-style chunked record format (native C++ fast path).
+
+Reference: /root/reference/paddle/fluid/recordio/{header.h:25,chunk.h:27,
+writer.h,scanner.h} + recordio_writer.py + the recordio reader op
+(operators/reader/create_recordio_file_reader_op.cc). See recordio.cc for
+the on-disk layout (original design, shared by both implementations here).
+
+API:
+    with Writer(path, compress=True) as w:
+        w.write(b"record bytes")
+    for rec in Scanner(path):          # yields bytes
+        ...
+    reader = recordio_reader(path)     # paddle-style reader decorator
+    write_recordio(path, iterable)     # bulk writer
+"""
+
+from paddle_tpu.recordio.recordio import (
+    PrefetchScanner, Scanner, Writer, count, native_available,
+    prefetch_reader, recordio_reader, write_recordio)
+
+__all__ = ["PrefetchScanner", "Scanner", "Writer", "count",
+           "native_available", "prefetch_reader", "recordio_reader",
+           "write_recordio"]

@@ -1,0 +1,164 @@
+"""C++ serving shim build + ctypes driver.
+
+Reference: the inference C++ API consumed by serving applications
+(/root/reference/paddle/fluid/inference/api/paddle_api.h,
+api/analysis_predictor.h:44,61, api/demo_ci/). `serving.cc` is the
+library; `demo.cc` a standalone C++ consumer; this module compiles both on
+demand (g++ + libpython; no pybind11 in this image) and provides
+`CPredictor`, a ctypes driver over the same C ABI — used by tests and by
+Python hosts that want the C contract.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.utils.native import cache_dir as _cache_dir
+
+_DTYPES = ["float32", "float64", "int32", "int64", "uint8", "int8",
+           "bool", "bfloat16", "float16"]
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _py_flags() -> List[str]:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_python_version()
+    return [f"-I{inc}", f"-L{libdir}", f"-lpython{ver}",
+            f"-Wl,-rpath,{libdir}"]
+
+
+def _build(src: str, out_name: str, shared: bool,
+           extra: Sequence[str] = ()) -> Optional[str]:
+    out = os.path.join(_cache_dir(), out_name)
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", src, "-o", tmp]
+    if shared:
+        cmd[2:2] = ["-shared", "-fPIC"]
+    cmd += list(extra) + _py_flags()
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def build_library() -> Optional[str]:
+    """Compile libptpu_serving.so; returns its path (cached) or None."""
+    return _build(os.path.join(_SRC_DIR, "serving.cc"),
+                  "libptpu_serving.so", shared=True)
+
+
+def build_demo() -> Optional[str]:
+    """Compile the standalone C++ demo binary (api/demo_ci capability)."""
+    lib = build_library()
+    if lib is None:
+        return None
+    return _build(os.path.join(_SRC_DIR, "demo.cc"), "ptpu_demo",
+                  shared=False, extra=[lib, f"-Wl,-rpath,{_cache_dir()}"])
+
+
+def build_train_demo() -> Optional[str]:
+    """Compile the standalone C++ *training* demo (reference
+    train/demo/demo_trainer.cc capability: a native app owning the train
+    loop, feeding C buffers zero-copy and checkpointing at the end)."""
+    return _build(os.path.join(_SRC_DIR, "train_demo.cc"),
+                  "ptpu_train_demo", shared=False)
+
+
+class _Tensor(ctypes.Structure):
+    _fields_ = [("dtype", ctypes.c_int), ("rank", ctypes.c_int),
+                ("shape", ctypes.POINTER(ctypes.c_int64)),
+                ("data", ctypes.c_void_p)]
+
+
+class CPredictor:
+    """ctypes driver over the serving C ABI (same contract a C++ host
+    uses; ≈ PaddlePredictor::Run through paddle_api.h)."""
+
+    def __init__(self, model_dir: str, sys_path: Optional[str] = None):
+        lib_path = build_library()
+        if lib_path is None:
+            raise RuntimeError("cannot build serving library (no g++?)")
+        lib = ctypes.CDLL(lib_path)
+        lib.ptpu_create.restype = ctypes.c_void_p
+        lib.ptpu_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.ptpu_ok.argtypes = [ctypes.c_void_p]
+        lib.ptpu_last_error.restype = ctypes.c_char_p
+        lib.ptpu_last_error.argtypes = [ctypes.c_void_p]
+        lib.ptpu_run.argtypes = [ctypes.c_void_p, ctypes.POINTER(_Tensor),
+                                 ctypes.c_int]
+        for name in ("ptpu_num_inputs", "ptpu_num_outputs",
+                     "ptpu_output_rank", "ptpu_output_dtype"):
+            getattr(lib, name).argtypes = [ctypes.c_void_p] + (
+                [ctypes.c_int] if "output_" in name else [])
+        lib.ptpu_output_rank.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_output_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_output_shape.restype = ctypes.POINTER(ctypes.c_int64)
+        lib.ptpu_output_shape.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_output_data.restype = ctypes.c_void_p
+        lib.ptpu_output_data.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_output_nbytes.restype = ctypes.c_int64
+        lib.ptpu_output_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptpu_destroy.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        repo_root = os.path.dirname(os.path.dirname(_SRC_DIR))
+        sp = sys_path if sys_path is not None else repo_root
+        self._h = lib.ptpu_create(model_dir.encode(), sp.encode())
+        if not lib.ptpu_ok(self._h):
+            err = lib.ptpu_last_error(self._h).decode()
+            lib.ptpu_destroy(self._h)
+            self._h = None
+            raise RuntimeError(f"ptpu_create failed: {err}")
+
+    def run(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        tensors = (_Tensor * len(arrays))()
+        keep = []
+        for i, a in enumerate(arrays):
+            a = np.ascontiguousarray(a)
+            keep.append(a)
+            shape = (ctypes.c_int64 * a.ndim)(*a.shape)
+            keep.append(shape)
+            tensors[i] = _Tensor(
+                _DTYPES.index(a.dtype.name), a.ndim, shape,
+                a.ctypes.data_as(ctypes.c_void_p))
+        if self._lib.ptpu_run(self._h, tensors, len(arrays)) != 0:
+            raise RuntimeError(
+                f"ptpu_run: {self._lib.ptpu_last_error(self._h).decode()}")
+        outs = []
+        for i in range(self._lib.ptpu_num_outputs(self._h)):
+            rank = self._lib.ptpu_output_rank(self._h, i)
+            shape = [self._lib.ptpu_output_shape(self._h, i)[d]
+                     for d in range(rank)]
+            dtype = _DTYPES[self._lib.ptpu_output_dtype(self._h, i)]
+            nbytes = self._lib.ptpu_output_nbytes(self._h, i)
+            buf = ctypes.string_at(self._lib.ptpu_output_data(self._h, i),
+                                   nbytes)
+            outs.append(np.frombuffer(buf, dtype=dtype).reshape(shape)
+                        .copy())
+        return outs
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ptpu_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+__all__ = ["CPredictor", "build_demo", "build_library"]

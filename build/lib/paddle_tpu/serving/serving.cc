@@ -1,0 +1,342 @@
+// C++ serving shim — native predictor API over exported models.
+//
+// Capability-equivalent of the reference inference C++ API
+// (/root/reference/paddle/fluid/inference/api/paddle_api.h PaddlePredictor
+// + PaddleTensor; api/analysis_predictor.h:44 AnalysisPredictor::Run :52,
+// ZeroCopyRun :61; api/demo_ci standalone consumer): a C++ application
+// links this library, loads a model directory exported by
+// paddle_tpu.io.inference.save_inference_model (StableHLO + params), and
+// serves it with zero-copy input buffers.
+//
+// Architecture (TPU-first, not a port): the reference's AnalysisPredictor
+// wraps its own C++ graph executor; here the XLA runtime IS the executor,
+// reached through an embedded CPython interpreter driving
+// paddle_tpu.io.inference.InferencePredictor. Input tensors cross the
+// C boundary as zero-copy memoryviews (numpy.frombuffer); outputs are
+// exposed through the buffer protocol and stay valid until the next Run —
+// the ZeroCopyTensor lifetime contract.
+//
+// Flat C ABI (pybind11 absent in this image; ctypes/C callers both work):
+//   ptpu_create(model_dir, sys_path)       -> handle | NULL
+//   ptpu_last_error(h)                     -> const char*
+//   ptpu_num_inputs/ptpu_input_name/_rank/_shape/_dtype(h, i)
+//   ptpu_run(h, tensors, n)                -> 0 | -1
+//   ptpu_num_outputs/_output_rank/_output_shape/_output_dtype/
+//   ptpu_output_data/_output_nbytes(h, i)
+//   ptpu_destroy(h)
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 serving.cc \
+//            $(python3-config --includes) -lpython3.12 -o libptpu_serving.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// dtype codes of the C ABI (stable, documented for C callers)
+const char* kDtypeNames[] = {"float32", "float64", "int32",   "int64",
+                             "uint8",   "int8",    "bool",    "bfloat16",
+                             "float16"};
+constexpr int kNumDtypes = 9;
+
+int dtype_code(const std::string& name) {
+  for (int i = 0; i < kNumDtypes; i++)
+    if (name == kDtypeNames[i]) return i;
+  return -1;
+}
+
+struct Output {
+  std::vector<int64_t> shape;
+  int dtype = -1;
+  PyObject* array = nullptr;  // owned contiguous ndarray keeping data alive
+  void* data = nullptr;
+  int64_t nbytes = 0;
+};
+
+struct Handle {
+  PyObject* predictor = nullptr;
+  PyObject* np = nullptr;
+  std::vector<Output> outputs;
+  std::vector<std::string> in_names;
+  std::vector<std::vector<int64_t>> in_shapes;
+  std::vector<int> in_dtypes;
+  std::string error;
+};
+
+bool g_we_initialized = false;
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+void set_py_error(Handle* h, const char* what) {
+  h->error = what;
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value) {
+      PyObject* s = PyObject_Str(value);
+      if (s) {
+        h->error += ": ";
+        h->error += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+}
+
+void clear_outputs(Handle* h) {
+  for (auto& o : h->outputs) Py_XDECREF(o.array);
+  h->outputs.clear();
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef struct {
+  int dtype;             // kDtypeNames index
+  int rank;
+  const int64_t* shape;
+  const void* data;      // row-major contiguous, not copied (zero-copy in)
+} PtpuTensor;
+
+void* ptpu_create(const char* model_dir, const char* extra_sys_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    PyEval_SaveThread();  // release the GIL so Gil{} works uniformly
+  }
+  Gil gil;
+  Handle* h = new Handle();
+
+  if (extra_sys_path && *extra_sys_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    std::string paths(extra_sys_path);
+    size_t start = 0;
+    while (start <= paths.size()) {
+      size_t sep = paths.find(':', start);
+      std::string p = paths.substr(
+          start, sep == std::string::npos ? std::string::npos : sep - start);
+      if (!p.empty()) {
+        PyObject* ps = PyUnicode_FromString(p.c_str());
+        PyList_Insert(sys_path, 0, ps);
+        Py_DECREF(ps);
+      }
+      if (sep == std::string::npos) break;
+      start = sep + 1;
+    }
+  }
+
+  h->np = PyImport_ImportModule("numpy");
+  PyObject* mod =
+      h->np ? PyImport_ImportModule("paddle_tpu.io.inference") : nullptr;
+  PyObject* cls =
+      mod ? PyObject_GetAttrString(mod, "InferencePredictor") : nullptr;
+  if (cls) {
+    h->predictor = PyObject_CallFunction(cls, "s", model_dir);
+  }
+  Py_XDECREF(cls);
+  Py_XDECREF(mod);
+  if (!h->predictor) {
+    set_py_error(h, "failed to create InferencePredictor");
+    // keep the handle so the caller can read the error; predictor==NULL
+    return h;
+  }
+
+  // cache the input signature for C-side introspection
+  PyObject* sig = PyObject_GetAttrString(h->predictor, "signature");
+  if (sig) {
+    PyObject* names = PyDict_GetItemString(sig, "input_names");  // borrowed
+    PyObject* inputs = PyDict_GetItemString(sig, "inputs");
+    if (names && inputs) {
+      Py_ssize_t n = PyList_Size(names);
+      for (Py_ssize_t i = 0; i < n; i++) {
+        h->in_names.push_back(PyUnicode_AsUTF8(PyList_GetItem(names, i)));
+        PyObject* item = PyList_GetItem(inputs, i);
+        PyObject* shp = PyDict_GetItemString(item, "shape");
+        PyObject* dt = PyDict_GetItemString(item, "dtype");
+        std::vector<int64_t> dims;
+        for (Py_ssize_t d = 0; d < PyList_Size(shp); d++)
+          dims.push_back(PyLong_AsLongLong(PyList_GetItem(shp, d)));
+        h->in_shapes.push_back(dims);
+        h->in_dtypes.push_back(dtype_code(PyUnicode_AsUTF8(dt)));
+      }
+    }
+    Py_DECREF(sig);
+  }
+  return h;
+}
+
+const char* ptpu_last_error(void* hp) {
+  return ((Handle*)hp)->error.c_str();
+}
+
+int ptpu_ok(void* hp) { return ((Handle*)hp)->predictor != nullptr; }
+
+int ptpu_num_inputs(void* hp) {
+  return (int)((Handle*)hp)->in_names.size();
+}
+
+const char* ptpu_input_name(void* hp, int i) {
+  return ((Handle*)hp)->in_names[i].c_str();
+}
+
+int ptpu_input_rank(void* hp, int i) {
+  return (int)((Handle*)hp)->in_shapes[i].size();
+}
+
+const int64_t* ptpu_input_shape(void* hp, int i) {
+  return ((Handle*)hp)->in_shapes[i].data();
+}
+
+int ptpu_input_dtype(void* hp, int i) {
+  return ((Handle*)hp)->in_dtypes[i];
+}
+
+int ptpu_run(void* hp, const PtpuTensor* tensors, int n) {
+  Handle* h = (Handle*)hp;
+  if (!h->predictor) {
+    h->error = "predictor not initialized";
+    return -1;
+  }
+  Gil gil;
+  clear_outputs(h);
+  h->error.clear();
+
+  PyObject* feed = PyList_New(n);
+  for (int i = 0; i < n; i++) {
+    const PtpuTensor& t = tensors[i];
+    int64_t elems = 1;
+    for (int d = 0; d < t.rank; d++) elems *= t.shape[d];
+    if (t.dtype < 0 || t.dtype >= kNumDtypes) {
+      Py_DECREF(feed);
+      h->error = "bad input dtype code";
+      return -1;
+    }
+    // itemsize via numpy dtype (handles bfloat16 through ml_dtypes,
+    // which importing paddle_tpu/jax registered)
+    PyObject* dt = PyObject_CallMethod(h->np, "dtype", "s",
+                                       kDtypeNames[t.dtype]);
+    if (!dt) {
+      Py_DECREF(feed);
+      set_py_error(h, "unknown dtype");
+      return -1;
+    }
+    PyObject* isz = PyObject_GetAttrString(dt, "itemsize");
+    int64_t nbytes = elems * PyLong_AsLongLong(isz);
+    Py_DECREF(isz);
+
+    PyObject* mv = PyMemoryView_FromMemory((char*)t.data, nbytes, PyBUF_READ);
+    PyObject* flat =
+        PyObject_CallMethod(h->np, "frombuffer", "OO", mv, dt);
+    Py_DECREF(mv);
+    Py_DECREF(dt);
+    PyObject* arr = nullptr;
+    if (flat) {
+      PyObject* shape = PyTuple_New(t.rank);
+      for (int d = 0; d < t.rank; d++)
+        PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+      arr = PyObject_CallMethod(flat, "reshape", "O", shape);
+      Py_DECREF(shape);
+      Py_DECREF(flat);
+    }
+    if (!arr) {
+      Py_DECREF(feed);
+      set_py_error(h, "failed to wrap input buffer");
+      return -1;
+    }
+    PyList_SET_ITEM(feed, i, arr);  // steals
+  }
+
+  PyObject* outs = PyObject_CallMethod(h->predictor, "run", "O", feed);
+  Py_DECREF(feed);
+  if (!outs) {
+    set_py_error(h, "predictor.run failed");
+    return -1;
+  }
+
+  Py_ssize_t n_out = PySequence_Size(outs);
+  for (Py_ssize_t i = 0; i < n_out; i++) {
+    PyObject* o = PySequence_GetItem(outs, i);  // new ref
+    PyObject* contig =
+        PyObject_CallMethod(h->np, "ascontiguousarray", "O", o);
+    Py_DECREF(o);
+    if (!contig) {
+      Py_DECREF(outs);
+      set_py_error(h, "output not convertible");
+      return -1;
+    }
+    Output out;
+    out.array = contig;
+    PyObject* shp = PyObject_GetAttrString(contig, "shape");
+    for (Py_ssize_t d = 0; d < PyTuple_Size(shp); d++)
+      out.shape.push_back(PyLong_AsLongLong(PyTuple_GetItem(shp, d)));
+    Py_DECREF(shp);
+    PyObject* dt = PyObject_GetAttrString(contig, "dtype");
+    PyObject* dname = PyObject_GetAttrString(dt, "name");
+    out.dtype = dtype_code(PyUnicode_AsUTF8(dname));
+    Py_DECREF(dname);
+    Py_DECREF(dt);
+    PyObject* iface = PyObject_GetAttrString(contig, "ctypes");
+    PyObject* ptr = PyObject_GetAttrString(iface, "data");
+    out.data = (void*)PyLong_AsUnsignedLongLong(ptr);
+    Py_DECREF(ptr);
+    Py_DECREF(iface);
+    PyObject* nb = PyObject_GetAttrString(contig, "nbytes");
+    out.nbytes = PyLong_AsLongLong(nb);
+    Py_DECREF(nb);
+    h->outputs.push_back(out);
+  }
+  Py_DECREF(outs);
+  return 0;
+}
+
+int ptpu_num_outputs(void* hp) {
+  return (int)((Handle*)hp)->outputs.size();
+}
+
+int ptpu_output_rank(void* hp, int i) {
+  return (int)((Handle*)hp)->outputs[i].shape.size();
+}
+
+const int64_t* ptpu_output_shape(void* hp, int i) {
+  return ((Handle*)hp)->outputs[i].shape.data();
+}
+
+int ptpu_output_dtype(void* hp, int i) {
+  return ((Handle*)hp)->outputs[i].dtype;
+}
+
+const void* ptpu_output_data(void* hp, int i) {
+  return ((Handle*)hp)->outputs[i].data;
+}
+
+int64_t ptpu_output_nbytes(void* hp, int i) {
+  return ((Handle*)hp)->outputs[i].nbytes;
+}
+
+void ptpu_destroy(void* hp) {
+  Handle* h = (Handle*)hp;
+  if (Py_IsInitialized()) {
+    Gil gil;
+    clear_outputs(h);
+    Py_XDECREF(h->predictor);
+    Py_XDECREF(h->np);
+  }
+  delete h;
+}
+
+}  // extern "C"

@@ -1,0 +1,5 @@
+"""Test harnesses (numeric-gradient OpTest; reference op_test.py:43,414)."""
+
+from paddle_tpu.testing.op_test import check_grad, check_output, numeric_grad
+
+__all__ = ["check_grad", "check_output", "numeric_grad"]

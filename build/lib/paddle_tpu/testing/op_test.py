@@ -1,0 +1,127 @@
+"""Numeric-gradient op-test harness.
+
+Capability-equivalent of the reference OpTest base
+(/root/reference/python/paddle/fluid/tests/unittests/op_test.py:43
+`get_numeric_gradient`, :414 `check_grad`): every differentiable op's
+analytic gradient (here: `jax.grad`, which differentiates the same traced
+computation XLA compiles) is checked against central finite differences.
+
+Differences from the reference, by design:
+- The reference perturbs one element at a time through a scratch
+  Scope/Executor; we perturb the pure function directly — same math,
+  no graph plumbing.
+- Checks run in float64 (via the `jax.enable_x64` context)
+  so the finite-difference truncation error, not float32 rounding,
+  dominates the tolerance. The reference uses fp32/fp64 with delta=0.005
+  (op_test.py:49); we default to eps=1e-5 / rtol=5e-4 in x64.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _tree_f64(tree):
+    return jax.tree_util.tree_map(
+        lambda a: (jnp.asarray(a, jnp.float64)
+                   if np.issubdtype(np.asarray(a).dtype, np.floating)
+                   else jnp.asarray(a)),
+        tree)
+
+
+def _scalarize(f: Callable, args: tuple, rng: np.random.RandomState):
+    """Wrap f so it returns sum(w_i * out_i) for fixed random weights w.
+
+    A random linear projection of the outputs exercises every output
+    element's gradient path (a plain sum() would let sign errors that
+    cancel across elements slip through).
+    """
+    outs = f(*args)
+    flat, treedef = jax.tree_util.tree_flatten(outs)
+    weights = [jnp.asarray(rng.randn(*np.shape(o)), jnp.result_type(o))
+               if np.issubdtype(np.asarray(o).dtype, np.floating) else None
+               for o in flat]
+
+    def scalar_f(*a):
+        flat_o = jax.tree_util.tree_leaves(f(*a))
+        tot = 0.0
+        for w, o in zip(weights, flat_o):
+            if w is not None:
+                tot = tot + jnp.vdot(w, o.astype(w.dtype))
+        return jnp.asarray(tot, jnp.float64)
+
+    return scalar_f
+
+
+def numeric_grad(scalar_f: Callable, args: tuple, argnum: int,
+                 eps: float = 1e-5) -> np.ndarray:
+    """Central-difference gradient of a scalar function w.r.t. args[argnum].
+
+    Perturbs every element independently, like the reference's
+    get_numeric_gradient (op_test.py:43) — O(n) function evaluations,
+    intended for the tiny shapes op tests use.
+    """
+    x = np.asarray(args[argnum], np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        for sign in (+1.0, -1.0):
+            pert = flat.copy()
+            pert[i] += sign * eps
+            new_args = list(args)
+            new_args[argnum] = jnp.asarray(pert.reshape(x.shape))
+            gflat[i] += sign * float(scalar_f(*new_args))
+        gflat[i] /= 2.0 * eps
+    return grad
+
+
+def check_grad(f: Callable, *args: Any,
+               argnums: Optional[Sequence[int]] = None,
+               eps: float = 1e-5, rtol: float = 5e-4, atol: float = 5e-5,
+               seed: int = 0, name: str = "") -> None:
+    """Assert jax.grad(f) matches finite differences at `args`.
+
+    argnums defaults to every floating-point positional argument.
+    Raises AssertionError with per-argument max abs/rel error on mismatch.
+    """
+    with jax.enable_x64():
+        args = tuple(_tree_f64(a) for a in args)
+        if argnums is None:
+            argnums = [i for i, a in enumerate(args)
+                       if all(np.issubdtype(np.asarray(l).dtype, np.floating)
+                              for l in jax.tree_util.tree_leaves(a))]
+        rng = np.random.RandomState(seed)
+        scalar_f = _scalarize(f, args, rng)
+        jitted = jax.jit(scalar_f)
+        analytic = jax.grad(scalar_f, argnums=tuple(argnums))(*args)
+        for an, g in zip(argnums, analytic):
+            num = numeric_grad(jitted, args, an, eps=eps)
+            got = np.asarray(g, np.float64)
+            err = np.abs(got - num)
+            denom = np.maximum(np.abs(num), 1.0)
+            ok = np.all(err <= atol + rtol * denom)
+            assert ok, (
+                f"gradient mismatch {name or getattr(f, '__name__', f)} "
+                f"arg {an}: max_abs_err={err.max():.3e} "
+                f"max_rel_err={(err / denom).max():.3e} "
+                f"(eps={eps}, rtol={rtol}, atol={atol})\n"
+                f"analytic:\n{got}\nnumeric:\n{num}")
+
+
+def check_output(f: Callable, ref: Callable, *args: Any,
+                 rtol: float = 1e-5, atol: float = 1e-6,
+                 name: str = "") -> None:
+    """Assert jit(f)(*args) matches a numpy reference implementation
+    (reference OpTest.check_output, op_test.py:303)."""
+    got = jax.tree_util.tree_leaves(jax.jit(f)(*args))
+    want = jax.tree_util.tree_leaves(ref(*[np.asarray(a) for a in args]))
+    assert len(got) == len(want), (
+        f"{name}: output arity {len(got)} != reference {len(want)}")
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), w, rtol=rtol, atol=atol,
+                                   err_msg=f"output mismatch in {name}")

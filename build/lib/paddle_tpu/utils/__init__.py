@@ -1,7 +1,7 @@
 from paddle_tpu.utils.flags import FLAGS
 from paddle_tpu.utils import log
 from paddle_tpu.utils.debug import (dump_hlo, memory_stats, module_tree,
-                                    module_tree_dot, op_census)
+                                    module_tree_dot)
 from paddle_tpu.utils.interop import (
     from_dlpack, from_torch, to_dlpack, to_torch, tree_from_torch,
 )

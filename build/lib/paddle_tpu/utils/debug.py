@@ -69,42 +69,6 @@ def dump_hlo(fn: Callable, *args, stage: str = "stablehlo",
                      "use jaxpr | stablehlo | optimized")
 
 
-def op_census(fn: Callable, *args, stage: str = "optimized",
-              static_argnums=(), **kwargs) -> Dict[str, int]:
-    """Op-type frequency table of the compiled program, most frequent
-    first (≈ the reference's benchmark/op_frequence.py op census — there
-    over ProgramDesc ops, here over the HLO/StableHLO that actually runs;
-    useful for spotting fusion regressions or unexpected op explosions).
-    """
-    import re
-
-    text = dump_hlo(fn, *args, stage=stage,
-                    static_argnums=static_argnums, **kwargs)
-    counts: Dict[str, int] = {}
-    # HLO: "%name = <type> opcode(...)" where <type> may be a tuple
-    # "(s32[], f32[8,8]{1,0:T(8,128)})" — the opcode is the first
-    # lowercase identifier directly followed by "(" after the "=" (tile
-    # annotations like T(8,128) start uppercase, so they don't match).
-    hlo_op = re.compile(r"=\s+[^=]*?\s([a-z][a-z0-9_\-]*)\(")
-    for line in text.splitlines():
-        line = line.strip()
-        op = None
-        if "stablehlo." in line or "mhlo." in line:
-            # StableHLO (MLIR): "%0 = stablehlo.opcode ..."
-            for tok in line.replace("(", " ").split():
-                if tok.startswith(("stablehlo.", "mhlo.")):
-                    op = tok.split(".", 1)[1].rstrip('"')
-                    break
-        elif "= " in line and not line.startswith(("HloModule", "ENTRY",
-                                                   "//", "#")):
-            m = hlo_op.search(line)
-            if m:
-                op = m.group(1)
-        if op:
-            counts[op] = counts.get(op, 0) + 1
-    return dict(sorted(counts.items(), key=lambda kv: -kv[1]))
-
-
 def _unwrap_params(variables: Optional[Dict]) -> Dict:
     """Accept a full variables dict or a bare params tree."""
     return (variables or {}).get("params", variables) or {}

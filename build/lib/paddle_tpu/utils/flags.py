@@ -1,0 +1,105 @@
+"""Global flag registry with environment-variable override.
+
+Capability-equivalent of the reference's gflags system (108 DEFINE_* flags
+surfaced to Python via `FLAGS_*` env vars; reference:
+python/paddle/fluid/__init__.py:126-165, paddle/fluid/platform/init.cc:40).
+
+TPU-first design: flags are plain Python values resolved once at import from
+`FLAGS_<name>` environment variables, with typed definitions and a process-wide
+singleton registry. No C++ gflags needed — XLA's own tuning knobs are reached
+through XLA_FLAGS which we deliberately do not wrap.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _FlagDef:
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    help: str
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+class FlagRegistry:
+    """Process-wide typed flag registry. Thread-safe."""
+
+    def __init__(self) -> None:
+        self._defs: Dict[str, _FlagDef] = {}
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help: str = "",
+               parser: Optional[Callable[[str], Any]] = None) -> None:
+        if parser is None:
+            if isinstance(default, bool):
+                parser = _parse_bool
+            elif isinstance(default, int):
+                parser = int
+            elif isinstance(default, float):
+                parser = float
+            else:
+                parser = str
+        with self._lock:
+            if name in self._defs:
+                return  # idempotent re-import
+            self._defs[name] = _FlagDef(name, default, parser, help)
+            env = os.environ.get(f"FLAGS_{name}")
+            self._values[name] = parser(env) if env is not None else default
+
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self._values:
+                raise KeyError(f"undefined flag: {name}")
+            return self._values[name]
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            if name not in self._defs:
+                raise KeyError(f"undefined flag: {name}")
+            self._values[name] = value
+
+    def all(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._values)
+
+
+FLAGS = FlagRegistry()
+
+# Core flags mirroring the reference's capability surface.
+FLAGS.define("check_nan_inf", False,
+             "Check outputs of every op for NaN/Inf (debug). "
+             "Analog of reference FLAGS_check_nan_inf.")
+FLAGS.define("deterministic", False,
+             "Force deterministic execution (seeded RNG streams, "
+             "XLA deterministic reductions where possible). Analog of "
+             "FLAGS_cudnn_deterministic/FLAGS_cpu_deterministic.")
+FLAGS.define("rpc_deadline", 180000,
+             "Deadline (ms) for control-plane RPCs (checkpoint notify etc.).")
+FLAGS.define("profile_dir", "",
+             "If set, enable jax.profiler traces into this directory.")
+FLAGS.define("benchmark", False, "Print per-step timing in trainers.")
+FLAGS.define("allocator_strategy", "default",
+             "Kept for config parity; XLA owns device memory on TPU.")
+FLAGS.define("eager_delete_tensor_gb", 0.0,
+             "Kept for config parity; XLA buffer liveness handles GC.")
+FLAGS.define("fraction_of_gpu_memory_to_use", 0.92,
+             "Kept for config parity with the reference flag surface.")
+
+
+def get_flags() -> Dict[str, Any]:
+    return FLAGS.all()
+
+
+def set_flags(d: Dict[str, Any]) -> None:
+    for k, v in d.items():
+        FLAGS.set(k, v)

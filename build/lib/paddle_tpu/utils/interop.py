@@ -1,0 +1,55 @@
+"""Zero-copy tensor interop (DLPack).
+
+Reference: framework/dlpack_tensor.{h,cc} — zero-copy tensor exchange
+with other frameworks. JAX speaks DLPack natively; these helpers add the
+framework-level conveniences: pytree-wide conversion and a torch bridge
+(torch-CPU round-trips are the common glue in data pipelines).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def to_dlpack(x):
+    """jax.Array -> DLPack capsule (zero-copy where the consumer allows).
+
+    Uses the array's standard __dlpack__ protocol (jax.dlpack.to_dlpack
+    was removed in newer jax). Consumers that only accept protocol
+    objects (e.g. jax's own from_dlpack) should be handed the array
+    itself, not this capsule."""
+    return x.__dlpack__()
+
+
+def from_dlpack(tensor):
+    """Any __dlpack__-bearing object (torch/np/jax array) -> jax.Array.
+
+    Note: newer jax only accepts protocol objects, not raw capsules —
+    pass the producer's array/tensor directly."""
+    return jax.dlpack.from_dlpack(tensor)
+
+
+def to_torch(x):
+    """jax.Array -> torch.Tensor via DLPack (CPU zero-copy)."""
+    import torch.utils.dlpack as tdl
+    return tdl.from_dlpack(x)
+
+
+def from_torch(t):
+    """torch.Tensor -> jax.Array via DLPack."""
+    return from_dlpack(t)
+
+
+def tree_from_torch(tree: Pytree) -> Pytree:
+    """Convert every torch.Tensor leaf of a pytree (e.g. a torch
+    state_dict or a torch DataLoader batch) into jax arrays."""
+    import torch
+
+    def leaf(x):
+        return from_torch(x) if isinstance(x, torch.Tensor) else x
+    return jax.tree.map(leaf, tree)

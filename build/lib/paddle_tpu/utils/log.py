@@ -1,0 +1,55 @@
+"""Leveled logging (VLOG-style) for the framework.
+
+Analog of the reference's glog `VLOG(n)` + InitGLOG (platform/init.cc:165)
+and pretty_log (string/pretty_log.h). Verbosity from FLAGS_v / GLOG_v env.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+
+_LOGGER = logging.getLogger("paddle_tpu")
+if not _LOGGER.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "%(levelname).1s %(asctime)s paddle_tpu %(message)s", "%H:%M:%S"))
+    _LOGGER.addHandler(_h)
+    _LOGGER.setLevel(logging.INFO)
+    _LOGGER.propagate = False
+
+_VERBOSITY = int(os.environ.get("FLAGS_v", os.environ.get("GLOG_v", "0")))
+
+
+def vlog(level: int, msg: str, *args) -> None:
+    if level <= _VERBOSITY:
+        _LOGGER.info(msg, *args)
+
+
+def info(msg: str, *args) -> None:
+    _LOGGER.info(msg, *args)
+
+
+def warning(msg: str, *args) -> None:
+    _LOGGER.warning(msg, *args)
+
+
+def error(msg: str, *args) -> None:
+    _LOGGER.error(msg, *args)
+
+
+class scoped_timer:
+    """`with scoped_timer("phase"):` — logs wall time of the block at VLOG(1)."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        vlog(1, "%s took %.3fs", self.name, time.perf_counter() - self.t0)
+        return False

@@ -1,0 +1,83 @@
+"""Shared build-on-demand scaffold for the native (C++) components.
+
+recordio.cc / datafeed.cc / serving.cc are compiled with g++ into a
+per-user cache dir and bound via ctypes (no pybind11 in this image —
+SURVEY §7 native-code policy). This module owns the common mechanics:
+cache-dir resolution, mtime staleness check, pid-suffixed tmp +
+atomic os.replace, and once-only memoization, so a fix lands in one
+place instead of three.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Callable, Optional, Sequence
+
+
+def cache_dir() -> str:
+    d = os.environ.get("PTPU_CACHE_DIR") or os.path.join(
+        tempfile.gettempdir(), f"paddle_tpu_native_{os.getuid()}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def build_shared(src: str, libname: str, extra_flags: Sequence[str] = (),
+                 timeout: float = 120.0) -> Optional[str]:
+    """Compile `src` into `<cache>/<libname>` (shared lib) if stale or
+    missing; returns the library path, or None when the toolchain or
+    source is unavailable."""
+    if not os.path.exists(src):
+        return None
+    out = os.path.join(cache_dir(), libname)
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+           *extra_flags, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=timeout)
+        os.replace(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+class LazyLib:
+    """Once-only loader: builds, CDLLs, and binds signatures on first use.
+
+    `bind(lib)` declares restype/argtypes; its exceptions mean an ABI
+    mismatch and propagate. Build/load failures memoize to None so pure-
+    Python fallbacks engage without retrying the compiler on every call.
+    """
+
+    def __init__(self, src: str, libname: str,
+                 bind: Callable[[ctypes.CDLL], None],
+                 extra_flags: Sequence[str] = ()):
+        self._src = src
+        self._libname = libname
+        self._bind = bind
+        self._extra = tuple(extra_flags)
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._tried = False
+
+    def get(self) -> Optional[ctypes.CDLL]:
+        with self._lock:
+            if not self._tried:
+                self._tried = True
+                path = build_shared(self._src, self._libname, self._extra)
+                if path is not None:
+                    try:
+                        lib = ctypes.CDLL(path)
+                    except OSError:
+                        lib = None
+                    if lib is not None:
+                        self._bind(lib)
+                        self._lib = lib
+            return self._lib

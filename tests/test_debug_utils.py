@@ -54,3 +54,22 @@ def test_module_tree_dot():
     assert "LeNet" in dot and "conv1" in dot
     assert "->" in dot and dot.rstrip().endswith("}")
     assert "params=" in dot
+
+
+def test_op_census():
+    """HLO op-frequency table (benchmark/op_frequence.py capability)."""
+    import jax.numpy as jnp
+    from paddle_tpu.utils import op_census
+
+    def f(x, w):
+        return jnp.tanh(x @ w) @ w
+
+    x = jnp.ones((4, 8)); w = jnp.ones((8, 8))
+    for stage in ("stablehlo", "optimized"):
+        census = op_census(f, x, w, stage=stage)
+        assert census, stage
+        assert any("dot" in k or "fusion" in k for k in census), (stage,
+                                                                  census)
+        # sorted most-frequent-first
+        vals = list(census.values())
+        assert vals == sorted(vals, reverse=True)
