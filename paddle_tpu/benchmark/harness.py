@@ -46,6 +46,37 @@ def device_peak_flops(dtype_bits: int = 16) -> Optional[float]:
     return None
 
 
+_SUSTAINED: Optional[float] = None
+
+
+def sustained_matmul_flops(min_time: float = 1.5) -> Optional[float]:
+    """Sustained single-chip bf16 matmul rate (FLOP/s), cached per
+    process (first call's measurement wins).
+
+    State-chained 8192x8192 matmul chains (step k+1 consumes step k's
+    output — see the run_timed caller contract; a fixed-input probe on
+    the axon pool measures multi-chip fleet throughput, not the chip).
+    Measured ~149 TFLOP/s on v5e = 76% of the published 197 peak, which
+    calibrates what fraction of the datasheet a perfectly matmul-dense
+    program can actually reach. Returns None off-TPU.
+    """
+    global _SUSTAINED
+    if _SUSTAINED is not None:
+        return _SUSTAINED
+    if jax.devices()[0].platform != "tpu":
+        return None
+    import jax.numpy as jnp
+    n, chain = 8192, 10
+    rs = np.random.RandomState(0)
+    a = jnp.asarray(rs.randn(n, n) * 0.01, jnp.bfloat16)
+    b = jnp.asarray(rs.randn(n, n) * 0.01, jnp.bfloat16)
+    g = jax.jit(lambda s, b: jax.lax.fori_loop(
+        0, chain, lambda i, c: (c @ b).astype(jnp.bfloat16), s))
+    sec, _, _ = run_timed(lambda s: (g(s, b),) * 2, a, min_time=min_time)
+    _SUSTAINED = chain * 2 * n ** 3 / sec
+    return _SUSTAINED
+
+
 def compiled_flops(jitted, *args) -> Optional[float]:
     """FLOPs per invocation from the compiled executable's cost analysis."""
     try:
@@ -104,6 +135,14 @@ def run_timed(step_once: Callable[[Any], Tuple[Any, Any]], state,
     T_A (N_A steps + sync) and a large one T_B (N_B steps + sync):
     per_step = (T_B - T_A) / (N_B - N_A) cancels the fixed cost exactly.
     N_B grows (doubling) until the subtracted window covers >= min_time.
+
+    CALLER CONTRACT: step k+1's computation must CONSUME step k's output
+    (thread it through `state`). The axon pool dispatches INDEPENDENT
+    calls concurrently across chips — measured: a fixed-input matmul loop
+    reporting 4,094 TFLOP/s on a 197 TFLOP/s chip — so a fixed-input step
+    measures fleet throughput, not the device. Training steps chain their
+    TrainState naturally; for inference/kernel timing, fold a scalar from
+    the previous output back into the input (see run_infer).
 
     Returns (seconds_per_step, steps_timed_total, final_state).
     """

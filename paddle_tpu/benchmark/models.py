@@ -288,13 +288,35 @@ def run_infer(name: str, batch_size: int = 16, dtype=jnp.float32,
     rs = np.random.RandomState(0)
     x = jnp.asarray(rs.randn(batch_size, img, img, 3), jnp.float32)
     variables = model.init(jax.random.key(0), x)
-    fwd = jax.jit(lambda v, xx: model.apply(v, xx, training=False))
+
+    # Two layers of chaining (run_timed caller contract): K forwards
+    # chained INSIDE one program (amortizes per-dispatch pool overhead
+    # that dominates a single small forward), and the scalar carry
+    # chained ACROSS steps (a fixed-input step would let the axon pool
+    # fan independent calls across chips and report fleet throughput).
+    K = 8 if jax.devices()[0].platform == "tpu" else 2
+
+    def kfwd(v, xx, s):
+        def body(i, c):
+            out = model.apply(v, xx + c, training=False)
+            # 1e-30, not 0: a mul-by-zero fold would sever the loop-
+            # carried dependence and let the whole body be DCE'd
+            return (out.ravel()[0] * 1e-30).astype(xx.dtype)
+        return jax.lax.fori_loop(0, K, body, s)
+
+    kfwd_j = jax.jit(kfwd)
 
     def step(s):
-        return s, fwd(variables, x)
+        s2 = kfwd_j(variables, x, s)
+        return s2, s2
 
-    sec, steps, _ = run_timed(step, None, min_time=min_time)
-    flops = compiled_flops(fwd, variables, x)
+    sec_k, steps, _ = run_timed(step, jnp.zeros((), x.dtype),
+                                min_time=min_time)
+    sec = sec_k / K
+    steps *= K
+    flops = compiled_flops(kfwd_j, variables, x, jnp.zeros((), x.dtype))
+    if flops:
+        flops /= K
     peak = device_peak_flops()
     baseline = INFER_BASELINES.get((name, batch_size))
     value = batch_size / sec
