@@ -76,7 +76,7 @@ def _longcontext_bench(seq: int = 16384):
     import jax.numpy as jnp
     import numpy as np
 
-    from paddle_tpu.benchmark.harness import run_timed
+    from paddle_tpu.benchmark.harness import chain_k, run_timed
     from paddle_tpu.kernels import attention as A
     from paddle_tpu.utils.flags import FLAGS
 
@@ -95,27 +95,15 @@ def _longcontext_bench(seq: int = 16384):
 
             g = jax.grad(loss, argnums=(0, 1, 2))
 
-            # Chained inside the program (K backwards per dispatch) and
-            # across steps via the scalar carry — run_timed caller
-            # contract; amortizes per-dispatch pool overhead. The carry
-            # touches ALL THREE grads (else XLA dead-code-eliminates the
-            # dk/dv matmuls of the dense path while the fused flash
-            # kernel cannot be pruned, biasing the comparison) and scales
-            # by 1e-30 rather than 0 (a mul-by-zero fold would sever the
-            # loop-carried dependence silently).
+            # harness.chain_k: K backwards per dispatch, carry touching
+            # ALL THREE grads (else XLA dead-code-eliminates the dense
+            # path's dk/dv matmuls while the fused flash kernel cannot
+            # be pruned, biasing the comparison).
             K = 4
-
-            def kgrad(q, k, v, s):
-                def body(i, c):
-                    gq, gk, gv = g(q + c, k, v)
-                    carry = (gq.ravel()[0] + gk.ravel()[0] + gv.ravel()[0])
-                    return (carry * 1e-30).astype(q.dtype)
-                return jax.lax.fori_loop(0, K, body, s)
-
-            kg = jax.jit(kgrad)
+            kg = chain_k(lambda c, q, k, v: g(q + c, k, v), K)
 
             sec_k, _, _ = run_timed(
-                lambda s: (kg(q, k, v, s),) * 2,
+                lambda s: (kg(s, q, k, v),) * 2,
                 jnp.zeros((), q.dtype), min_time=1.0)
             out[f"attn16k_{label}_ms"] = round(sec_k / K * 1e3, 2)
     finally:
@@ -168,13 +156,31 @@ def _retry(fn, attempts: int = 2):
     raise last
 
 
-def main():
+def _devices_or_reexec():
+    """jax.devices(), with bounded whole-process retries on backend-init
+    failure (observed: the axon tunnel going UNAVAILABLE for minutes at a
+    time — a transient must not cost the round its recorded benchmark).
+    Re-exec gives each retry a clean backend-init attempt; JAX caches a
+    failed backend within a process."""
     import jax
+    try:
+        return jax.devices()
+    except RuntimeError as e:
+        n = int(os.environ.get("PTPU_BENCH_INIT_RETRY", "0"))
+        if n < 4:
+            sys.stderr.write(f"backend init failed ({e}); retry {n + 1}\n")
+            time.sleep(120)
+            env = dict(os.environ, PTPU_BENCH_INIT_RETRY=str(n + 1))
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        raise
+
+
+def main():
     import jax.numpy as jnp
 
     from paddle_tpu.benchmark import run_model
 
-    on_tpu = jax.devices()[0].platform == "tpu"
+    on_tpu = _devices_or_reexec()[0].platform == "tpu"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     min_time = 2.5 if on_tpu else 0.2
     bs = 64 if on_tpu else 8

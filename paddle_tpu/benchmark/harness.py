@@ -46,6 +46,31 @@ def device_peak_flops(dtype_bits: int = 16) -> Optional[float]:
     return None
 
 
+def chain_k(fn: Callable, k: int):
+    """Jitted K-iteration chained step for run_timed's caller contract.
+
+    `fn(carry, *args) -> array or tuple of arrays` runs K times inside
+    ONE program (amortizing per-dispatch pool overhead), with a scalar
+    carry derived from EVERY output threaded into the next iteration —
+    touching all outputs so XLA cannot dead-code-eliminate any of them,
+    scaled by 1e-30 rather than 0 because a mul-by-zero fold would sever
+    the loop-carried dependence and let the body be eliminated silently.
+    The returned jitted callable maps (carry, *args) -> carry; divide the
+    measured step time by K.
+    """
+    def kstep(s, *args):
+        def body(i, c):
+            outs = fn(c, *args)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            carry = outs[0].ravel()[0]
+            for o in outs[1:]:
+                carry = carry + o.ravel()[0].astype(carry.dtype)
+            return (carry * 1e-30).astype(s.dtype)
+        return jax.lax.fori_loop(0, k, body, s)
+    return jax.jit(kstep)
+
+
 _SUSTAINED: Optional[float] = None
 
 

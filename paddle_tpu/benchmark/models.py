@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from paddle_tpu.benchmark.harness import BenchResult, bench_trainer
+from paddle_tpu.benchmark.harness import (BenchResult, bench_trainer,
+                                           chain_k)
 from paddle_tpu.core.executor import Trainer, supervised_loss
 from paddle_tpu.metrics import accuracy
 from paddle_tpu.ops import functional as F
@@ -289,32 +290,24 @@ def run_infer(name: str, batch_size: int = 16, dtype=jnp.float32,
     x = jnp.asarray(rs.randn(batch_size, img, img, 3), jnp.float32)
     variables = model.init(jax.random.key(0), x)
 
-    # Two layers of chaining (run_timed caller contract): K forwards
-    # chained INSIDE one program (amortizes per-dispatch pool overhead
-    # that dominates a single small forward), and the scalar carry
+    # Two layers of chaining (run_timed caller contract, harness.chain_k):
+    # K forwards chained INSIDE one program (amortizes per-dispatch pool
+    # overhead that dominates a single small forward), and the carry
     # chained ACROSS steps (a fixed-input step would let the axon pool
     # fan independent calls across chips and report fleet throughput).
     K = 8 if jax.devices()[0].platform == "tpu" else 2
-
-    def kfwd(v, xx, s):
-        def body(i, c):
-            out = model.apply(v, xx + c, training=False)
-            # 1e-30, not 0: a mul-by-zero fold would sever the loop-
-            # carried dependence and let the whole body be DCE'd
-            return (out.ravel()[0] * 1e-30).astype(xx.dtype)
-        return jax.lax.fori_loop(0, K, body, s)
-
-    kfwd_j = jax.jit(kfwd)
+    kfwd_j = chain_k(
+        lambda c, v, xx: model.apply(v, xx + c, training=False), K)
 
     def step(s):
-        s2 = kfwd_j(variables, x, s)
+        s2 = kfwd_j(s, variables, x)
         return s2, s2
 
     sec_k, steps, _ = run_timed(step, jnp.zeros((), x.dtype),
                                 min_time=min_time)
     sec = sec_k / K
     steps *= K
-    flops = compiled_flops(kfwd_j, variables, x, jnp.zeros((), x.dtype))
+    flops = compiled_flops(kfwd_j, jnp.zeros((), x.dtype), variables, x)
     if flops:
         flops /= K
     peak = device_peak_flops()

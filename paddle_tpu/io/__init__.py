@@ -1,6 +1,6 @@
 from paddle_tpu.io.checkpoint import (
     load_checkpoint, load_persistables, save_checkpoint, save_persistables,
-    latest_checkpoint, CheckpointManager,
+    latest_checkpoint, AsyncCheckpointer, CheckpointManager,
 )
 from paddle_tpu.io.inference import (
     save_inference_model, load_inference_model, InferencePredictor,

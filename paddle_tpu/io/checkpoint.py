@@ -42,7 +42,8 @@ import os
 import re
 import shutil
 import tempfile
-from typing import Any, Dict, List, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -67,10 +68,50 @@ def _is_multiprocess() -> bool:
     return jax.process_count() > 1
 
 
+_barrier_lock = threading.Lock()
+_barrier_seq: Dict[str, int] = {}   # per-barrier-name use counts
+
+
 def _barrier(name: str) -> None:
-    if _is_multiprocess():
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+    """Cross-process barrier over the coordination service (host-side
+    RPC), NOT a device collective: an async-save worker thread must be
+    able to hit this while the main thread keeps dispatching training
+    programs — sync_global_devices from a second thread deadlocks the
+    device stream (observed).
+
+    Barrier ids must agree across processes. `name` already embeds the
+    checkpoint path and stage; the per-NAME use count (not a global
+    counter) disambiguates repeated saves to the same path without
+    coupling independent save streams — a global counter would make ids
+    depend on thread interleaving when an async save overlaps a sync
+    save to a different path. Within one path's stream, ordering is the
+    single-writer contract every save already requires.
+    """
+    if not _is_multiprocess():
+        return
+    try:
+        from jax._src import distributed as _distributed
+        client = _distributed.global_state.client
+    except (ImportError, AttributeError):
+        client = None
+    if client is not None:
+        with _barrier_lock:
+            seq = _barrier_seq.get(name, 0)
+            _barrier_seq[name] = seq + 1
+        key = f"ptpu-ckpt:{seq}:{name}".replace("/", "|")
+        client.wait_at_barrier(key, 600_000)
+        return
+    # No coordination client (private jax API moved?): the device-
+    # collective fallback is only safe on the main thread — from a
+    # worker thread it would race the training stream (the deadlock this
+    # barrier exists to avoid), so fail loudly instead.
+    if threading.current_thread() is not threading.main_thread():
+        raise RuntimeError(
+            "checkpoint barrier: no coordination-service client available "
+            "(jax._src.distributed.global_state moved?) and a device-"
+            "collective barrier cannot run from the async-save thread")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
 
 
 def _index_to_json(index, shape) -> List[List[int]]:
@@ -133,15 +174,13 @@ def _clear_markers(path: str) -> None:
             pass
 
 
-def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
-                    metadata: Optional[Dict] = None) -> str:
-    """Write `tree` to directory `path` atomically. Returns the path.
+def _snapshot(tree: Pytree):
+    """Device→host snapshot of the shards this process owns.
 
-    Every process participates: each writes the shards it owns (exactly
-    one process holds replica 0 of any shard index, so each piece of data
-    is written once globally). Process 0 additionally writes the manifest
-    and commits the rename. Assumes a shared filesystem across processes
-    (the same assumption the reference's pserver checkpointing makes).
+    Runs on the CALLING thread (the arrays may be donated/overwritten by
+    the very next train step, so the copies must exist before control
+    returns); the result is pure host data that `_write_snapshot` can
+    persist from any thread.
     """
     flat = _flatten(tree)
     proc = jax.process_index()
@@ -169,7 +208,28 @@ def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
                      "index": _index_to_json((slice(None),) * arr.ndim,
                                              shape)})
         leaves_meta.append({"key": key, "shape": list(shape), "dtype": dtype})
+    return leaves_meta, my_shards, my_index, proc
 
+
+def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
+                    metadata: Optional[Dict] = None) -> str:
+    """Write `tree` to directory `path` atomically. Returns the path.
+
+    Every process participates: each writes the shards it owns (exactly
+    one process holds replica 0 of any shard index, so each piece of data
+    is written once globally). Process 0 additionally writes the manifest
+    and commits the rename. Assumes a shared filesystem across processes
+    (the same assumption the reference's pserver checkpointing makes).
+    """
+    snap = _snapshot(tree)
+    return _write_snapshot(path, snap, step, metadata)
+
+
+def _write_snapshot(path: str, snap, step: Optional[int],
+                    metadata: Optional[Dict]) -> str:
+    """File/commit phase over a host snapshot — no device access, safe to
+    run on a background thread (AsyncCheckpointer)."""
+    leaves_meta, my_shards, my_index, proc = snap
     multi = _is_multiprocess()
     if multi:
         # Deterministic staging dir: all processes must agree on the name.
@@ -416,26 +476,90 @@ def latest_checkpoint(directory: str) -> Optional[str]:
     return best[1] if best else None
 
 
+class AsyncCheckpointer:
+    """Background-thread checkpoint writes (the orbax-style async tier,
+    SURVEY §5.4): `save` snapshots device shards to host ON THE CALLING
+    THREAD (the arrays may be donated/overwritten by the very next train
+    step) and hands the serialize/commit to a worker thread, hiding the
+    file I/O — usually the dominant cost — behind training.
+
+    Single-writer ordering: a save while one is in flight joins it first.
+    A background failure re-raises on the next save()/wait(). Call
+    wait() before reading the checkpoint back or exiting the process.
+    In multi-process mode every process's save() participates in the
+    commit barriers from its worker thread, so all processes must keep
+    the same save cadence (same contract as the sync path).
+    """
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, path: str, tree: Pytree, step: Optional[int] = None,
+             metadata: Optional[Dict] = None,
+             _after: Optional[Callable[[], None]] = None) -> str:
+        self.wait()
+        snap = _snapshot(tree)
+
+        def work():
+            try:
+                _write_snapshot(path, snap, step, metadata)
+                if _after is not None:
+                    _after()
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=work, daemon=True,
+                                        name="ptpu-async-ckpt")
+        self._thread.start()
+        return path
+
+    def wait(self) -> None:
+        """Join the in-flight write; re-raise its failure, if any."""
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
+
+
 class CheckpointManager:
     """Rotation + resume policy over save/load (elastic-recovery story §5.3:
     restart-from-checkpoint replaces the reference's nonexistent elasticity,
-    and checkpoint-notify becomes a plain directory convention)."""
+    and checkpoint-notify becomes a plain directory convention).
 
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    `async_save=True` routes saves through AsyncCheckpointer: the call
+    returns once device shards are snapshotted to host and the write +
+    rotation happen behind training. `wait()` (also called automatically
+    by restore_latest) drains the in-flight write.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = False):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        self._async = AsyncCheckpointer() if async_save else None
         os.makedirs(directory, exist_ok=True)
 
     def save(self, tree: Pytree, step: int,
              metadata: Optional[Dict] = None) -> str:
         path = os.path.join(self.directory, f"ckpt-{step}")
+        if self._async is not None:
+            return self._async.save(path, tree, step=step,
+                                    metadata=metadata, _after=self._gc)
         save_checkpoint(path, tree, step=step, metadata=metadata)
         self._gc()
         return path
 
+    def wait(self) -> None:
+        if self._async is not None:
+            self._async.wait()
+
     def restore_latest(self, target: Optional[Pytree] = None,
                        shardings: Optional[Pytree] = None
                        ) -> Tuple[Optional[Pytree], Optional[int]]:
+        self.wait()   # an in-flight async save IS the latest checkpoint
         path = latest_checkpoint(self.directory)
         if path is None:
             return None, None

@@ -55,7 +55,9 @@ def main():
 
     gbs = 4 * ndev
     ts = trainer.init_state(jnp.zeros((gbs, 6)))
-    mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+    mgr = CheckpointManager(
+        ckpt_dir, max_to_keep=2,
+        async_save=bool(int(os.environ.get("PTPU_ASYNC_CKPT", "0"))))
     restored, start_step = mgr.restore_latest(ts)
     if restored is not None:
         ts = restored
@@ -85,6 +87,7 @@ def main():
         steps.append(step)
         losses.append(float(fetches["loss"]))
         mgr.save(ts, step=step + 1)
+    mgr.wait()   # drain an in-flight async save before exiting
 
     print(json.dumps({"proc": proc, "start_step": start_step,
                       "steps": steps, "losses": losses}))

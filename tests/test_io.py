@@ -142,3 +142,64 @@ def test_v1_checkpoint_read_compat(tmp_path):
     restored = load_checkpoint(path, target=tree)
     np.testing.assert_allclose(restored["w"], tree["w"])
     np.testing.assert_allclose(restored["b"], tree["b"])
+
+
+def test_async_checkpointer_parity_and_ordering(tmp_path):
+    """AsyncCheckpointer: same on-disk result as the sync path; a second
+    save joins the in-flight one (single-writer ordering)."""
+    from paddle_tpu.io import AsyncCheckpointer
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    ac = AsyncCheckpointer()
+    ac.save(str(tmp_path / "a"), ts, step=1)
+    ac.save(str(tmp_path / "b"), ts, step=2)   # joins save of "a" first
+    ac.wait()
+    for name, step in (("a", 1), ("b", 2)):
+        restored = load_checkpoint(str(tmp_path / name), target=ts)
+        for x, y in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_async_checkpoint_survives_donated_source(tmp_path):
+    """The snapshot happens before save() returns: donating/overwriting
+    the source arrays afterwards must not corrupt the checkpoint."""
+    from paddle_tpu.io import AsyncCheckpointer
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    want = [np.asarray(x).copy() for x in jax.tree.leaves(ts)]
+    ac = AsyncCheckpointer()
+    ac.save(str(tmp_path / "ck"), ts, step=0)
+    # train_step donates ts: its buffers are consumed immediately
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 3, 4))
+    trainer.train_step(ts, (x, y))
+    ac.wait()
+    restored2 = load_checkpoint(str(tmp_path / "ck"),
+                                target=trainer.init_state(jnp.zeros((4, 6))))
+    for w, g in zip(want, jax.tree.leaves(restored2)):
+        np.testing.assert_array_equal(w, np.asarray(g))
+
+
+def test_async_error_propagates(tmp_path):
+    from paddle_tpu.io import AsyncCheckpointer
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    ac = AsyncCheckpointer()
+    bad = tmp_path / "no" / "such" / "deep" / "dir" / "ck"
+    ac.save(str(bad), ts, step=0)
+    with pytest.raises(RuntimeError, match="async checkpoint"):
+        ac.wait()
+    ac.wait()  # error is consumed; subsequent waits are clean
+
+
+def test_manager_async_rotation_and_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=2, async_save=True)
+    trainer = _trainer()
+    ts = trainer.init_state(jnp.zeros((4, 6)))
+    for step in (1, 2, 3):
+        mgr.save(ts, step=step)
+    restored, step = mgr.restore_latest(target=ts)  # waits internally
+    assert step == 3
+    mgr.wait()
+    names = sorted(n for n in os.listdir(tmp_path) if n.startswith("ckpt-"))
+    assert names == ["ckpt-2", "ckpt-3"]  # rotation ran in the background
