@@ -421,6 +421,18 @@ def load_checkpoint(path: str, target: Optional[Pytree] = None,
             dtype = getattr(ref, "dtype", np.dtype(meta["dtype"]))
             if shard_flat is not None:
                 sharding = shard_flat[i][1]
+            elif isinstance(ref, jax.Array):
+                # No explicit shardings: restore onto the TARGET's own
+                # sharding (a donated/deleted target still carries its
+                # sharding metadata). Without this, a restored fsdp state
+                # came back as host numpy and the train step's donation
+                # paired differently-sharded in/out buffers — an XLA
+                # "aliased input/output size" crash on the first step
+                # after resume.
+                sharding = ref.sharding
+            else:
+                sharding = None
+            if sharding is not None:
                 memo: Dict[Tuple, np.ndarray] = {}
 
                 def cb(idx, _leaf=leaf, _shape=shape, _dtype=dtype,

@@ -203,3 +203,33 @@ def test_manager_async_rotation_and_restore(tmp_path):
     mgr.wait()
     names = sorted(n for n in os.listdir(tmp_path) if n.startswith("ckpt-"))
     assert names == ["ckpt-2", "ckpt-3"]  # rotation ran in the background
+
+
+def test_restore_onto_sharded_target_then_step(tmp_path):
+    """Restoring with only `target=` must land leaves on the target's own
+    shardings: a numpy-restored fsdp state used to crash the donated
+    train step with an XLA aliased-buffer size mismatch."""
+    from paddle_tpu.parallel import (DistStrategy, MeshConfig, MeshTrainer,
+                                     ReduceStrategy, make_mesh)
+    from paddle_tpu.parallel.sharding import fsdp_rules
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y))
+    tr = MeshTrainer(
+        MLP(hidden=(64,), num_classes=4), SGD(0.1), loss_fn, mesh,
+        strategy=DistStrategy(reduce_strategy=ReduceStrategy.REDUCE),
+        rules=fsdp_rules(min_size=64))
+    ts = tr.init_state(jnp.zeros((8, 6)))
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(ts, step=1)
+    restored, step = mgr.restore_latest(ts)
+    assert step == 1
+    # every restored leaf carries the target's sharding
+    for a, b in zip(jax.tree.leaves(ts), jax.tree.leaves(restored)):
+        assert isinstance(b, jax.Array)
+        assert b.sharding == a.sharding
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 6), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 4, 8))
+    restored, fetches = tr.train_step(restored, tr.put_batch((x, y)))
+    assert np.isfinite(float(fetches["loss"]))
