@@ -164,22 +164,73 @@ def _retry(fn, attempts: int = 2):
 
 
 def _devices_or_reexec():
-    """jax.devices(), with bounded whole-process retries on backend-init
-    failure (observed: the axon tunnel going UNAVAILABLE for minutes at a
-    time — a transient must not cost the round its recorded benchmark).
-    Re-exec gives each retry a clean backend-init attempt; JAX caches a
-    failed backend within a process."""
+    """jax.devices(), robust to a flaky tunnel (observed: hours-long
+    UNAVAILABLE windows, and init calls that HANG rather than error).
+
+    Backend init is probed in a SUBPROCESS with a hard timeout first, so
+    a hung tunnel can be retried — an in-process hang is unkillable from
+    inside. Once a probe succeeds, init in-process (re-exec clears any
+    cached failed-backend state). Bounded retries; budget time spent
+    waiting counts against _BUDGET_S via the PTPU_BENCH_T0 anchor."""
+    def give_up(detail):
+        # An in-process init would HANG unkillably on a dead tunnel;
+        # print an honest zero-valued line instead of vanishing. (bs64 is
+        # the TPU series: the driver only records bench runs on TPU.)
+        sys.stderr.write(f"backend unreachable, giving up: {detail}\n")
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_bs64", "value": 0,
+            "unit": "imgs/s", "vs_baseline": 0,
+            "extra": {"error": "TPU backend unreachable after "
+                               f"{int(_elapsed())}s of retries; no "
+                               "measurement taken", "probe": detail}}))
+        sys.exit(0)
+
+    probe = ("import jax\n"
+             "print('PLATFORM=' + jax.devices()[0].platform)\n")
+    n = int(os.environ.get("PTPU_BENCH_INIT_RETRY", "0"))
+    # n > 0 means we re-exec'd because a probe just succeeded: skip
+    # straight to the in-process init.
+    while n == 0:
+        try:
+            t0 = time.time()
+            r = subprocess.run([sys.executable, "-c", probe],
+                               capture_output=True, text=True, timeout=120)
+            ok = "PLATFORM=" in r.stdout
+            detail = (r.stdout + r.stderr)[-200:]
+            transient = (time.time() - t0 > 20
+                         or "UNAVAILABLE" in detail
+                         or "Unavailable" in detail)
+        except subprocess.TimeoutExpired:
+            ok, detail, transient = False, "init probe hung >120s", True
+        if ok:
+            n = int(os.environ.get("PTPU_BENCH_PROBE_FAILS", "0"))
+            break
+        if not transient:
+            # fast deterministic failure (broken env, import error):
+            # retrying cannot help
+            give_up(detail)
+        fails = int(os.environ.get("PTPU_BENCH_PROBE_FAILS", "0")) + 1
+        os.environ["PTPU_BENCH_PROBE_FAILS"] = str(fails)
+        if fails > 6 or _elapsed() + 210 > _BUDGET_S:
+            give_up(detail)
+        sys.stderr.write(f"backend probe failed (try {fails}): {detail}\n")
+        time.sleep(90)
+    if n and os.environ.get("PTPU_BENCH_INIT_RETRY") != str(n):
+        # re-exec so the retried init starts from a clean backend cache
+        env = dict(os.environ, PTPU_BENCH_INIT_RETRY=str(n))
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
     import jax
     try:
         return jax.devices()
-    except RuntimeError as e:
-        n = int(os.environ.get("PTPU_BENCH_INIT_RETRY", "0"))
-        if n < 4:
-            sys.stderr.write(f"backend init failed ({e}); retry {n + 1}\n")
-            time.sleep(120)
-            env = dict(os.environ, PTPU_BENCH_INIT_RETRY=str(n + 1))
+    except RuntimeError as e:   # tunnel flapped between probe and init
+        m = int(os.environ.get("PTPU_BENCH_INIT_FLAP", "0"))
+        if m < 2 and _elapsed() + 210 < _BUDGET_S:
+            sys.stderr.write(f"init failed after probe ok ({e}); retry\n")
+            time.sleep(60)
+            env = dict(os.environ, PTPU_BENCH_INIT_FLAP=str(m + 1),
+                       PTPU_BENCH_INIT_RETRY="0")
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
-        raise
+        give_up(f"init failed after successful probe: {e}")
 
 
 def main():
