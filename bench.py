@@ -188,18 +188,18 @@ def _devices_or_reexec():
     probe = ("import jax\n"
              "print('PLATFORM=' + jax.devices()[0].platform)\n")
     n = int(os.environ.get("PTPU_BENCH_INIT_RETRY", "0"))
-    # n > 0 means we re-exec'd because a probe just succeeded: skip
-    # straight to the in-process init.
-    while n == 0:
+    # Probe only under the tunnel (where init can hang); n > 0 means we
+    # re-exec'd because a probe just succeeded — skip straight to init.
+    while n == 0 and os.environ.get("PALLAS_AXON_POOL_IPS") is not None:
         try:
             t0 = time.time()
             r = subprocess.run([sys.executable, "-c", probe],
                                capture_output=True, text=True, timeout=120)
             ok = "PLATFORM=" in r.stdout
-            detail = (r.stdout + r.stderr)[-200:]
-            transient = (time.time() - t0 > 20
-                         or "UNAVAILABLE" in detail
-                         or "Unavailable" in detail)
+            full = r.stdout + r.stderr          # classify on everything,
+            detail = full[-200:]                # truncate for display
+            transient = (time.time() - t0 > 20 or "UNAVAILABLE" in full
+                         or "Unavailable" in full)
         except subprocess.TimeoutExpired:
             ok, detail, transient = False, "init probe hung >120s", True
         if ok:
@@ -207,8 +207,11 @@ def _devices_or_reexec():
             break
         if not transient:
             # fast deterministic failure (broken env, import error):
-            # retrying cannot help
-            give_up(detail)
+            # retrying cannot help, and a zero line would record the
+            # breakage as a green run — fail loudly instead
+            sys.stderr.write(f"bench init probe failed "
+                             f"deterministically:\n{full[-2000:]}\n")
+            sys.exit(1)
         fails = int(os.environ.get("PTPU_BENCH_PROBE_FAILS", "0")) + 1
         os.environ["PTPU_BENCH_PROBE_FAILS"] = str(fails)
         if fails > 6 or _elapsed() + 210 > _BUDGET_S:
