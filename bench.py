@@ -180,6 +180,10 @@ def _devices_or_reexec():
         print(json.dumps({
             "metric": "resnet50_train_imgs_per_sec_bs64", "value": 0,
             "unit": "imgs/s", "vs_baseline": 0,
+            # top-level no_measurement separates "no measurement taken"
+            # from "measured zero" for any consumer regressing on the
+            # series; the driver still gets its one JSON line.
+            "no_measurement": True,
             "extra": {"error": "TPU backend unreachable after "
                                f"{int(_elapsed())}s of retries; no "
                                "measurement taken", "probe": detail}}))

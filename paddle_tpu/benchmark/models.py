@@ -307,9 +307,12 @@ def run_infer(name: str, batch_size: int = 16, dtype=jnp.float32,
                                 min_time=min_time)
     sec = sec_k / K
     steps *= K
+    # XLA's cost analysis doesn't model fori_loop trip counts — the
+    # chained program's body is counted ONCE — so the undivided figure
+    # already equals one forward (plus a negligible carry add). Dividing
+    # by K (as before) understated flops ~K-fold; recompiling the
+    # unchained forward just for FLOPs would cost a second full compile.
     flops = compiled_flops(kfwd_j, jnp.zeros((), x.dtype), variables, x)
-    if flops:
-        flops /= K
     peak = device_peak_flops()
     baseline = INFER_BASELINES.get((name, batch_size))
     value = batch_size / sec

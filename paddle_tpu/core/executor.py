@@ -24,7 +24,10 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
-from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
+import weakref
+from collections import OrderedDict
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
 
 import jax
 import jax.numpy as jnp
@@ -335,15 +338,19 @@ class Executor:
 
     def __init__(self, place: Optional[Any] = None):
         self.place = place or jax.devices()[0]
-        # Keyed on the program object itself (not id()): entries hold a
-        # strong reference, so an id can never be recycled and served a
-        # stale executable. Bound methods hash by (__self__, __func__), so
-        # the per-call method object still hits its entry. The compiled
-        # jax.jit wrapper references the program anyway, so weakrefs could
-        # never evict — a plain dict is the honest structure; close()
-        # releases everything.
-        self._cache: Dict[Callable, Dict[Tuple, Callable]] = {}
+        # Keyed on (program, signature): the program object itself (not
+        # id()) so an id can never be recycled and served a stale
+        # executable; bound methods hash by (__self__, __func__), so the
+        # per-call method object still hits its entry. LRU-bounded by
+        # FLAGS_executor_cache_capacity (read per run, so tests and
+        # long-lived servers can retune it live); a long-lived process
+        # running many distinct programs evicts oldest-used instead of
+        # growing without bound. close() releases everything.
+        self._cache: "OrderedDict[Tuple, Callable]" = OrderedDict()
         self.cache_misses = 0
+        self.cache_hits = 0
+        self.cache_evictions = 0
+        _live_executors.add(self)
 
     @staticmethod
     def _signature(feed: Dict[str, Any]) -> Tuple:
@@ -358,13 +365,22 @@ class Executor:
         """program(**feed) -> dict of outputs; returns [outputs[k] for k in
         fetch_list] as numpy-convertible arrays (or the full dict)."""
         feed = feed or {}
-        key = self._signature(feed)
-        per_fn = self._cache.setdefault(program, {})
-        if key not in per_fn:
-            per_fn[key] = jax.jit(program)
+        key = (program, self._signature(feed))
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = self._cache[key] = jax.jit(program)
             self.cache_misses += 1
+        else:
+            self._cache.move_to_end(key)
+            self.cache_hits += 1
+        # enforce on hits too: lowering the flag live must shrink an
+        # all-hit working set, not wait for the next miss
+        cap = FLAGS.get("executor_cache_capacity")
+        while cap > 0 and len(self._cache) > cap:
+            self._cache.popitem(last=False)
+            self.cache_evictions += 1
         with RecordEvent("Executor.run"):
-            out = per_fn[key](**{k: jnp.asarray(v) for k, v in feed.items()})
+            out = fn(**{k: jnp.asarray(v) for k, v in feed.items()})
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(out, "program outputs")
         if fetch_list is None:
@@ -377,8 +393,23 @@ class Executor:
             raise ExecutorError(f"fetch targets not produced: {missing}")
         return [out[k] for k in fetch_list]
 
+    def cache_stats(self) -> Dict[str, int]:
+        return {"entries": len(self._cache), "hits": self.cache_hits,
+                "misses": self.cache_misses,
+                "evictions": self.cache_evictions}
+
     def close(self) -> None:
         self._cache.clear()
+
+
+# Live executors, for utils.debug.memory_stats' executor_caches section
+# (weak: an Executor's lifetime is its owner's business, not the stats').
+_live_executors: "weakref.WeakSet[Executor]" = weakref.WeakSet()
+
+
+def executor_cache_stats() -> List[Dict[str, int]]:
+    """Aggregate compile-cache stats over all live Executors."""
+    return [e.cache_stats() for e in _live_executors]
 
 
 class NaiveExecutor:

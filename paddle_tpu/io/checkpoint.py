@@ -194,7 +194,10 @@ def _snapshot(tree: Pytree):
                 if shard.replica_id != 0:
                     continue
                 slot = f"a{i}_s{len(my_index)}"
-                my_shards[slot] = np.asarray(shard.data)
+                # copy=True: on CPU backends jax.Array→numpy can be
+                # zero-copy, and a view into a donated buffer would be
+                # overwritten by the next train step.
+                my_shards[slot] = np.array(shard.data, copy=True)
                 my_index.append({"leaf": i, "slot": slot,
                                  "index": _index_to_json(shard.index, shape)})
         else:
@@ -220,6 +223,15 @@ def save_checkpoint(path: str, tree: Pytree, step: Optional[int] = None,
     is written once globally). Process 0 additionally writes the manifest
     and commits the rename. Assumes a shared filesystem across processes
     (the same assumption the reference's pserver checkpointing makes).
+
+    Multi-process cadence contract: every process must call
+    save_checkpoint the same number of times for any given `path` —
+    the barrier ids embed a per-path sequence counter held in process
+    memory, so a process that locally retries a failed save (or a
+    restarted process rejoining mid-stream) desynchronizes the counters
+    and every peer blocks for the full barrier timeout. Use
+    CheckpointManager (unique ckpt-{step} directory per save) when saves
+    may be retried or processes may restart.
     """
     snap = _snapshot(tree)
     return _write_snapshot(path, snap, step, metadata)

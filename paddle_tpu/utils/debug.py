@@ -47,6 +47,15 @@ def memory_stats(device=None) -> Dict[str, Any]:
     return out if device is None else out[str(devs[0])]
 
 
+def executor_cache_stats():
+    """Compile-cache stats over all live Executors (entries/hits/misses/
+    evictions per cache) — the host-side complement to memory_stats'
+    device-allocator numbers. Kept separate so memory_stats' return stays
+    a pure device→stats mapping."""
+    from paddle_tpu.core.executor import executor_cache_stats as _stats
+    return _stats()
+
+
 def dump_hlo(fn: Callable, *args, stage: str = "stablehlo",
              static_argnums=(), **kwargs) -> str:
     """Text dump of the compiled form of `fn(*args)`.

@@ -83,6 +83,11 @@ FLAGS.define("deterministic", False,
              "Force deterministic execution (seeded RNG streams, "
              "XLA deterministic reductions where possible). Analog of "
              "FLAGS_cudnn_deterministic/FLAGS_cpu_deterministic.")
+FLAGS.define("executor_cache_capacity", 256,
+             "Max compiled (program, signature) entries an Executor "
+             "retains (LRU eviction). <=0 disables the bound. Analog of "
+             "the reference's executor program-cache, which grows "
+             "unboundedly (executor.py prepared-context cache).", int)
 FLAGS.define("rpc_deadline", 180000,
              "Deadline (ms) for control-plane RPCs (checkpoint notify etc.).")
 FLAGS.define("profile_dir", "",

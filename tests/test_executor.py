@@ -27,6 +27,33 @@ def test_executor_run_feed_fetch():
     # program cache: same signature → no new compile
     exe.run(program, feed={"x": np.zeros(4), "y": np.zeros(4)})
     assert exe.cache_misses == 1
+    assert exe.cache_hits == 1
+
+
+def test_executor_cache_lru_eviction():
+    old_cap = pt.FLAGS.get("executor_cache_capacity")
+    pt.FLAGS.set("executor_cache_capacity", 2)
+    try:
+        exe = Executor()
+
+        def program(x):
+            return {"y": x + 1}
+
+        for n in (1, 2, 3):  # three distinct signatures, capacity 2
+            exe.run(program, feed={"x": np.ones(n)})
+        assert exe.cache_misses == 3
+        assert exe.cache_evictions == 1
+        stats = exe.cache_stats()
+        assert stats["entries"] == 2
+        # the evicted (oldest) signature recompiles; the newest hits
+        exe.run(program, feed={"x": np.ones(3)})
+        assert exe.cache_hits == 1
+        exe.run(program, feed={"x": np.ones(1)})
+        assert exe.cache_misses == 4
+        from paddle_tpu.utils.debug import executor_cache_stats
+        assert any(c["evictions"] >= 1 for c in executor_cache_stats())
+    finally:
+        pt.FLAGS.set("executor_cache_capacity", old_cap)
 
 
 def test_naive_executor():
