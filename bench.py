@@ -120,6 +120,44 @@ def _longcontext_bench(seq: int = 16384):
     return out
 
 
+def _moe_bench(min_time: float = 1.0):
+    """Masked vs all_to_all MoE dispatch cost at E=8 (top-2, cf=1.25).
+
+    Even single-chip the difference is structural: masked dispatch runs
+    every token through every expert (E× dense-FFN FLOPs), a2a runs each
+    expert on only its capacity buffer (k·cf× dense) — so the step-cost
+    ratio approaches E/(k·cf) ≈ 3.2 when FFN compute dominates."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.benchmark.harness import chain_k, run_timed
+    from paddle_tpu.parallel import MeshConfig, make_mesh
+    from paddle_tpu.parallel.moe import init_moe_params, moe_ffn, moe_ffn_a2a
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    E, D, HID, T = (8, 1024, 4096, 8192) if on_tpu else (8, 64, 128, 512)
+    mesh = make_mesh(MeshConfig(ep=1), devices=jax.devices()[:1])
+    mp = init_moe_params(jax.random.key(0), E, D, HID, dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.RandomState(0).randn(T, D),
+                    jnp.bfloat16) * 0.3
+    out = {}
+    for label, fn in (
+            ("masked", lambda p, xx: moe_ffn(p, xx, k=2)[0]),
+            ("a2a", lambda p, xx: moe_ffn_a2a(p, xx, mesh=mesh, k=2,
+                                              capacity_factor=1.25)[0])):
+        g = jax.grad(lambda p, xx: jnp.mean(
+            fn(p, xx).astype(jnp.float32) ** 2))
+        K = 4
+        kg = chain_k(lambda c, p, xx: g(p, xx + c)["gate"], K)
+        sec_k, _, _ = run_timed(lambda s: (kg(s, mp, x),) * 2,
+                                jnp.zeros((), x.dtype), min_time=min_time)
+        out[f"moe_e8_{label}_ms"] = round(sec_k / K * 1e3, 2)
+    out["moe_a2a_speedup"] = round(
+        out["moe_e8_masked_ms"] / out["moe_e8_a2a_ms"], 2)
+    return out
+
+
 def _resnet_s2d(min_time: float, bs: int = 128):
     """ResNet-50 with the space-to-depth stem (equivalent-capacity
     reparameterization; PERF_NOTES.md addendum)."""
@@ -338,6 +376,12 @@ def main():
             extra.update(_scaling_subprocess())
         except Exception as e:
             extra["scaling_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("moe"):  # MoE dispatch: masked (E×) vs all_to_all (k·cf×)
+        try:
+            extra.update(_retry(lambda: _moe_bench(min_time=min_time)))
+        except Exception as e:
+            extra["moe_error"] = f"{type(e).__name__}: {e}"[:160]
 
     if _gate("resnet50_s2d"):  # s2d stem variant (PERF_NOTES: +1%)
         try:

