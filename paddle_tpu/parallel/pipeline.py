@@ -11,10 +11,20 @@ this is a TPU-native extension completing the advertised mesh axes
   stage s computes microbatch (t - s); activations hop one stage per tick
   via a single `ppermute` over ICI. Bubble fraction is the standard
   (S - 1) / (M + S - 1).
-- Backward needs no hand-written schedule: `ppermute` is linear, its
-  transpose is the reverse rotation, so jax.grad through pipeline_apply
-  yields the mirrored backward pipeline automatically — the compiler owns
-  the schedule, exactly the XLA-first stance of this framework.
+- The microbatch buffer is SHARDED over pp in a strided layout
+  (microbatch t lives on device t mod S), so resident input memory is
+  O(batch/S) per device, not O(batch). Each tick, the owner of the
+  needed microbatch injects it with one masked psum (activation-sized,
+  the same order as the ppermute hop) — SPMD-uniform, static collectives.
+- `pipeline_stream` additionally folds the loss INTO the scan: the last
+  stage consumes each finished microbatch (head + loss) the tick it
+  completes, so no O(batch) output buffer ever materialises — this is
+  the path `PipelinedLM` trains through under MeshTrainer.
+- Backward needs no hand-written schedule: `ppermute`/`psum` are linear,
+  their transposes are the reverse rotation/broadcast, so jax.grad
+  through the scan yields the mirrored backward pipeline automatically —
+  the compiler owns the schedule, exactly the XLA-first stance of this
+  framework.
 
 All devices run the same program on identically-shaped data (masked when
 idle) — SPMD-uniform, no per-stage programs to compile.
@@ -23,12 +33,14 @@ idle) — SPMD-uniform, no per-stage programs to compile.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.module import Context, Module, PARAMS
 
 Pytree = Any
 
@@ -39,6 +51,31 @@ def stack_stage_params(per_stage: Sequence[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
+def _check_stages(stacked_params: Pytree, s: int, axis: str) -> None:
+    """The stage stack must match the mesh axis 1:1 — each device holds
+    exactly one stage's slice; a mismatch would silently run only the
+    first S_mesh stages."""
+    leaves = jax.tree.leaves(stacked_params)
+    if leaves and leaves[0].shape[0] != s:
+        raise ValueError(
+            f"stacked stage dim {leaves[0].shape[0]} != mesh '{axis}' size "
+            f"{s}; pipeline stages must map 1:1 onto the axis (run the "
+            "dense forward instead when unsharded)")
+
+
+def _strided(xs: jax.Array, s: int) -> Tuple[jax.Array, int]:
+    """[M, ...] -> ([ceil(M/s), s, ...], M): microbatch t at [t//s, t%s].
+
+    Zero-pads M up to a multiple of s; the tick masks (`t < m`) keep the
+    padding out of the math."""
+    m = xs.shape[0]
+    mp = -(-m // s) * s
+    if mp != m:
+        xs = jnp.concatenate(
+            [xs, jnp.zeros((mp - m,) + xs.shape[1:], xs.dtype)])
+    return xs.reshape((mp // s, s) + xs.shape[1:]), m
+
+
 def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                    stacked_params: Pytree, microbatches: jax.Array,
                    mesh: Mesh, axis: str = "pp"):
@@ -46,27 +83,33 @@ def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
 
     stage_fn(params, x) -> y with y.shape == x.shape (equal-width stages —
     the usual transformer-block case). stacked_params: leading dim S
-    sharded over `axis`. microbatches: [M, mb, ...] (replicated input).
-    Returns [M, mb, ...] outputs (replicated), differentiable end to end.
+    sharded over `axis`. microbatches: [M, mb, ...]; resident per-device
+    input is the strided O(M/S) shard. Returns [M, mb, ...] outputs
+    (replicated — use `pipeline_stream` to avoid materialising them),
+    differentiable end to end.
     """
     s = mesh.shape[axis]
-    m = microbatches.shape[0]
-    if m < 1:
+    _check_stages(stacked_params, s, axis)
+    if microbatches.shape[0] < 1:
         raise ValueError("need at least one microbatch")
+    xs_str, m = _strided(microbatches, s)
+    total = m + s - 1
+    fwd_perm = [(i, (i + 1) % s) for i in range(s)]
 
-    def local(params, xs):
-        # params: [1, ...] this stage's slice; xs: full [M, mb, ...]
+    def local(params, xs_l):
+        # params: [1, ...] this stage's slice; xs_l: [ceil(M/S), 1, mb, ...]
         params = jax.tree.map(lambda p: p[0], params)
+        xs_l = jax.tree.map(lambda x: x[:, 0], xs_l)
         stage = lax.axis_index(axis)
-        total = m + s - 1
-        fwd_perm = [(i, (i + 1) % s) for i in range(s)]
-        zero = jnp.zeros_like(xs[0])
+        zero = jnp.zeros_like(xs_l[0])
 
         def tick(carry, t):
             buf = carry                       # activation arriving this tick
-            # stage 0 ingests microbatch t (while t < m); later stages use
-            # the rotated buffer
-            x_in = jnp.where(t < m, xs[jnp.minimum(t, m - 1)], zero)
+            # the owner (t mod S) of microbatch t injects it; one
+            # activation-sized psum delivers it to stage 0
+            cand = xs_l[jnp.minimum(t, m - 1) // s]
+            x_in = lax.psum(
+                jnp.where((stage == t % s) & (t < m), cand, zero), axis)
             x_t = jnp.where(stage == 0, x_in, buf)
             y = stage_fn(params, x_t)
             # the last stage's result for microbatch (t - (s-1)) is ready
@@ -81,26 +124,230 @@ def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
         outs = lax.psum(outs[s - 1:], axis)
         return outs
 
-    in_specs = (P(axis), P())          # params sharded by stage, xs replic.
+    in_specs = (P(axis), P(None, axis))   # params by stage; xs strided
     out_specs = P()
-    return jax.shard_map(partial(local), mesh=mesh,
+    return jax.shard_map(local, mesh=mesh,
                          in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)(stacked_params, microbatches)
+                         check_vma=False)(stacked_params, xs_str)
+
+
+def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
+                    consume_fn: Callable[[Pytree, jax.Array, jax.Array],
+                                         jax.Array],
+                    mesh: Mesh, axis: str = "pp",
+                    batch_axes: Sequence[str] = ()):
+    """Build fn(stacked_params, aux_params, xs, ys) -> mean scalar loss.
+
+    The full streaming pipeline: inputs arrive via the strided conveyor,
+    and the tick a microbatch leaves the last stage, that stage runs
+    `consume_fn(aux_params, last_stage_out, ys[j]) -> scalar` (e.g. LM
+    head + cross-entropy) and accumulates — per-device live data never
+    exceeds the O(batch/S) input shard plus one activation. `batch_axes`
+    lists mesh axes the microbatch dim is data-parallel over (the loss is
+    pmean'd across them; grads flow through the psum transposes).
+    """
+    baxes = tuple(batch_axes)
+
+    def fn(stacked_params, aux_params, xs, ys):
+        s = mesh.shape[axis]
+        _check_stages(stacked_params, s, axis)
+        xs_str, m = _strided(xs, s)
+        ys_str, _ = _strided(ys, s)
+        total = m + s - 1
+        fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+
+        def local(params, aux, xs_l, ys_l):
+            params = jax.tree.map(lambda p: p[0], params)
+            xs_l = xs_l[:, 0]
+            ys_l = ys_l[:, 0]
+            stage = lax.axis_index(axis)
+            zero = jnp.zeros_like(xs_l[0])
+
+            def tick(carry, t):
+                buf, acc = carry
+                cand = xs_l[jnp.minimum(t, m - 1) // s]
+                x_in = lax.psum(
+                    jnp.where((stage == t % s) & (t < m), cand, zero), axis)
+                x_t = jnp.where(stage == 0, x_in, buf)
+                y = stage_fn(params, x_t)
+                # microbatch j finished on the last stage this tick; its
+                # targets stream in from their strided owner the same way
+                j = t - (s - 1)
+                jc = jnp.clip(j, 0, m - 1)
+                t_cand = ys_l[jc // s]
+                tgt = lax.psum(
+                    jnp.where((stage == jc % s) & (j >= 0), t_cand,
+                              jnp.zeros_like(t_cand)), axis)
+                li = consume_fn(aux, y, tgt)
+                acc = acc + jnp.where((stage == s - 1) & (j >= 0),
+                                      li.astype(jnp.float32), 0.0)
+                return (lax.ppermute(y, axis, fwd_perm), acc), None
+
+            (_, acc), _ = lax.scan(
+                tick, (zero, jnp.zeros((), jnp.float32)), jnp.arange(total))
+            loss = lax.psum(acc, axis) / m     # replicate across pp
+            if baxes:
+                loss = lax.pmean(loss, baxes)  # data-parallel mean
+            return loss
+
+        in_specs = (P(axis), P(),
+                    P(None, axis, baxes if baxes else None),
+                    P(None, axis, baxes if baxes else None))
+        return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                             out_specs=P(), check_vma=False)(
+                                 stacked_params, aux_params, xs_str, ys_str)
+    return fn
 
 
 def pipeline_loss_fn(stage_fn: Callable, loss_of_outputs: Callable,
                      mesh: Mesh, axis: str = "pp",
                      num_microbatches: Optional[int] = None):
-    """Build a MeshTrainer-compatible capability: params -> scalar loss.
+    """Build a capability fn(stacked_params, batch_x, batch_y) -> loss.
 
-    Returns fn(stacked_params, batch_x, batch_y) that splits the batch
-    into microbatches, pipelines the forward, and averages
-    loss_of_outputs(y_pred, y_true) over microbatches.
+    Splits the batch into microbatches and streams them through
+    `pipeline_stream` (loss computed in-scan; no replicated output
+    buffer), averaging loss_of_outputs(y_pred, y_true) over microbatches.
     """
+    stream = pipeline_stream(
+        stage_fn, lambda _aux, pred, tgt: jnp.mean(loss_of_outputs(pred,
+                                                                   tgt)),
+        mesh, axis)
+
     def fn(stacked_params, x, y):
         mb = num_microbatches or mesh.shape[axis]
         xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
         ys = y.reshape((mb, y.shape[0] // mb) + y.shape[1:])
-        outs = pipeline_apply(stage_fn, stacked_params, xs, mesh, axis)
-        return jnp.mean(jax.vmap(loss_of_outputs)(outs, ys))
+        return stream(stacked_params, (), xs, ys)
     return fn
+
+
+# -- a pipelined transformer LM for the trainer stack ------------------------
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def lm_block(p: Pytree, x: jax.Array, n_heads: int) -> jax.Array:
+    """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
+    [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked params."""
+    b, t, d = x.shape
+    hd = d // n_heads
+    h = _layernorm(x, p["ln1_s"], p["ln1_b"])
+    qkv = h @ p["w_qkv"]                                    # [mb,T,3D]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, n_heads, hd)
+    k = k.reshape(b, t, n_heads, hd)
+    v = v.reshape(b, t, n_heads, hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
+    mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+    s = jnp.where(mask[None, None], s, -1e30)
+    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    x = x + o.reshape(b, t, d) @ p["w_o"]
+    h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
+    return x + jax.nn.relu(h2 @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+
+
+class PipelinedLM(Module):
+    """Decoder-only LM whose transformer blocks are S pipeline stages.
+
+    Params: embed/pos/head (+ final LN) live OUTSIDE the pipeline
+    (replicated); the S blocks are stacked on a leading dim for
+    P("pp", ...) sharding (`pipeline_rules`). `forward` runs the exact
+    dense computation (init / eval / single-device parity);
+    `pipelined_lm_loss` is the streaming pp×dp training path over the
+    same parameters.
+    """
+
+    def __init__(self, vocab: int, d_model: int = 64, n_heads: int = 4,
+                 d_ff: int = 128, num_stages: int = 4, max_len: int = 128,
+                 dtype=jnp.float32):
+        super().__init__()
+        if d_model % n_heads:
+            raise ValueError("n_heads must divide d_model")
+        self.vocab, self.d_model, self.n_heads = vocab, d_model, n_heads
+        self.d_ff, self.num_stages, self.max_len = d_ff, num_stages, max_len
+        self.dtype = dtype
+
+    def _params(self, cx: Context):
+        from paddle_tpu.nn import initializers as I
+        v, d, f, s = self.vocab, self.d_model, self.d_ff, self.num_stages
+        dt = self.dtype
+        emb = cx.param("embed", (v, d), I.normal(0.0, 0.02), dt)
+        pos = cx.param("pos", (self.max_len, d), I.normal(0.0, 0.02), dt)
+        sx = cx.scope("stages")
+        stages = {
+            "w_qkv": sx.param("w_qkv", (s, d, 3 * d), I.xavier(), dt),
+            "w_o": sx.param("w_o", (s, d, d), I.xavier(), dt),
+            "ln1_s": sx.param("ln1_s", (s, d), I.constant(1.0), dt),
+            "ln1_b": sx.param("ln1_b", (s, d), I.constant(0.0), dt),
+            "w1": sx.param("w1", (s, d, f), I.xavier(), dt),
+            "b1": sx.param("b1", (s, f), I.constant(0.0), dt),
+            "w2": sx.param("w2", (s, f, d), I.xavier(), dt),
+            "b2": sx.param("b2", (s, d), I.constant(0.0), dt),
+            "ln2_s": sx.param("ln2_s", (s, d), I.constant(1.0), dt),
+            "ln2_b": sx.param("ln2_b", (s, d), I.constant(0.0), dt),
+        }
+        lnf_s = cx.param("lnf_s", (d,), I.constant(1.0), dt)
+        lnf_b = cx.param("lnf_b", (d,), I.constant(0.0), dt)
+        head = cx.param("head", (d, v), I.xavier(), dt)
+        return emb, pos, stages, lnf_s, lnf_b, head
+
+    def forward(self, cx: Context, tokens):
+        emb, pos, stages, lnf_s, lnf_b, head = self._params(cx)
+        x = emb[tokens] + pos[: tokens.shape[1]]
+
+        def body(x, stage_p):
+            return lm_block(stage_p, x, self.n_heads), None
+
+        x, _ = lax.scan(body, x, stages)        # scan over the stage dim
+        return _layernorm(x, lnf_s, lnf_b) @ head
+
+
+def pipeline_rules(axis: str = "pp"):
+    """Sharding rules for PipelinedLM (+ its optimizer slots): stage
+    stacks over `axis`, everything else replicated."""
+    from paddle_tpu.parallel.sharding import ShardingRules
+    return ShardingRules([(r"(^|/)stages/", (axis,))])
+
+
+def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
+                      num_microbatches: Optional[int] = None,
+                      batch_axes: Sequence[str] = ("dp",)):
+    """MeshTrainer loss_fn training PipelinedLM through the pipeline.
+
+    batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
+    (default 2·S) divides B. Embedding runs before the pipeline,
+    head + cross-entropy stream inside it on the last stage.
+    """
+    from paddle_tpu.ops import functional as F
+    baxes = tuple(a for a in batch_axes if a in mesh.shape)
+
+    def loss_fn(module, variables, batch, rng, training):
+        tok_in, tok_out = batch
+        p = variables[PARAMS]
+        s = mesh.shape[axis]
+        m = num_microbatches or 2 * s
+        b, t = tok_in.shape
+        if b % m:
+            raise ValueError(
+                f"microbatch count {m} must divide batch size {b}")
+
+        h = p["embed"][tok_in] + p["pos"][:t]
+        xs = h.reshape((m, b // m) + h.shape[1:])
+        ys = tok_out.reshape((m, b // m) + tok_out.shape[1:])
+
+        def consume(aux, y_mb, tgt_mb):
+            lnf_s, lnf_b, head = aux
+            logits = _layernorm(y_mb, lnf_s, lnf_b) @ head
+            return jnp.mean(F.softmax_with_cross_entropy(
+                logits.astype(jnp.float32), tgt_mb))
+
+        stream = pipeline_stream(
+            partial(lm_block, n_heads=module.n_heads), consume, mesh,
+            axis, batch_axes=baxes)
+        loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
+                      xs, ys)
+        return (loss, {}), {}
+    return loss_fn
