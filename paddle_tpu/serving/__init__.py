@@ -87,13 +87,24 @@ class CPredictor:
     """ctypes driver over the serving C ABI (same contract a C++ host
     uses; ≈ PaddlePredictor::Run through paddle_api.h)."""
 
-    def __init__(self, model_dir: str, sys_path: Optional[str] = None):
+    def __init__(self, model_dir: str, sys_path: Optional[str] = None,
+                 _cloned_from: Optional["CPredictor"] = None):
+        if _cloned_from is not None:
+            if not _cloned_from._h:
+                raise RuntimeError("cannot clone a closed CPredictor")
+            self._lib = _cloned_from._lib
+            self._h = self._lib.ptpu_clone(_cloned_from._h)
+            if not self._h:
+                raise RuntimeError("ptpu_clone failed")
+            return
         lib_path = build_library()
         if lib_path is None:
             raise RuntimeError("cannot build serving library (no g++?)")
         lib = ctypes.CDLL(lib_path)
         lib.ptpu_create.restype = ctypes.c_void_p
         lib.ptpu_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.ptpu_clone.restype = ctypes.c_void_p
+        lib.ptpu_clone.argtypes = [ctypes.c_void_p]
         lib.ptpu_ok.argtypes = [ctypes.c_void_p]
         lib.ptpu_last_error.restype = ctypes.c_char_p
         lib.ptpu_last_error.argtypes = [ctypes.c_void_p]
@@ -148,6 +159,12 @@ class CPredictor:
             outs.append(np.frombuffer(buf, dtype=dtype).reshape(shape)
                         .copy())
         return outs
+
+    def clone(self) -> "CPredictor":
+        """Per-thread handle sharing the loaded model (≈
+        PaddlePredictor::Clone): a CPredictor is NOT thread-safe (run
+        rewrites its output slots) — clone one per serving thread."""
+        return CPredictor("", _cloned_from=self)
 
     def close(self) -> None:
         if self._h:
