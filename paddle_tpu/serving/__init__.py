@@ -134,6 +134,8 @@ class CPredictor:
             raise RuntimeError(f"ptpu_create failed: {err}")
 
     def run(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        if not self._h:   # NULL would segfault inside ptpu_run
+            raise RuntimeError("CPredictor is closed")
         tensors = (_Tensor * len(arrays))()
         keep = []
         for i, a in enumerate(arrays):

@@ -126,6 +126,44 @@ def test_cifar100_pickle_tar_parser(tmp_path, monkeypatch):
     assert [int(r[1]) for r in rows] == [10, 20, 99]
 
 
+def test_mnist_test_split_idx_parser(tmp_path, monkeypatch):
+    """The t10k-prefixed test-split files engage the same idx parser."""
+    d = tmp_path / "mnist"
+    d.mkdir()
+    images = np.full((1, 28, 28), 9, np.uint8)
+    with gzip.open(d / "t10k-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 1, 28, 28) + images.tobytes())
+    with gzip.open(d / "t10k-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, 1) + np.array([5], np.uint8)
+                .tobytes())
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows = list(datasets.mnist_test()())
+    assert len(rows) == 1 and int(rows[0][1]) == 5
+
+
+def test_cifar_test_split_members(tmp_path, monkeypatch):
+    """cifar-10 'test_batch' and cifar-100 'test' members engage the
+    file parser for the *_test reader factories too."""
+    d = tmp_path / "cifar"
+    d.mkdir()
+    data = np.random.RandomState(1).randint(
+        0, 256, (2, 3072)).astype(np.uint8)
+    b10 = tmp_path / "test_batch"
+    b10.write_bytes(pickle.dumps({b"data": data, b"labels": [7, 8]}))
+    with tarfile.open(d / "cifar-10-python.tar.gz", "w:gz") as tf:
+        tf.add(b10, arcname="cifar-10-batches-py/test_batch")
+    b100 = tmp_path / "test"
+    b100.write_bytes(pickle.dumps(
+        {b"data": data, b"fine_labels": [42, 1], b"coarse_labels": [0, 1]}))
+    with tarfile.open(d / "cifar-100-python.tar.gz", "w:gz") as tf:
+        tf.add(b100, arcname="cifar-100-python/test")
+    monkeypatch.setitem(FLAGS._values, "data_dir", str(tmp_path))
+    rows10 = list(datasets.cifar10_test()())
+    assert [int(r[1]) for r in rows10] == [7, 8]
+    rows100 = list(datasets.cifar100_test()())
+    assert [int(r[1]) for r in rows100] == [42, 1]
+
+
 def test_imikolov_ngram_count_honored():
     rows = list(datasets.imikolov_ngram_train(synthetic_n=100)())
     assert len(rows) == 100

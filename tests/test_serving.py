@@ -178,10 +178,14 @@ def test_cpredictor_clone_throughput(model_dir):
     serial = n * n_threads / (time.perf_counter() - t0)
 
     clones = [base.clone() for _ in range(n_threads)]
+    errors = []
 
     def worker(c):
-        for _ in range(n):
-            c.run([x])
+        try:
+            for _ in range(n):
+                c.run([x])
+        except Exception as e:   # a dead worker must fail the test, not
+            errors.append(e)     # inflate the measured rate
 
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, args=(c,)) for c in clones]
@@ -190,6 +194,7 @@ def test_cpredictor_clone_throughput(model_dir):
     for t in threads:
         t.join(timeout=300)
     assert not any(t.is_alive() for t in threads), "worker thread hung"
+    assert not errors, errors
     conc = n * n_threads / (time.perf_counter() - t0)
     print(f"\nserving throughput: serial={serial:.0f}/s "
           f"4-thread clones={conc:.0f}/s ({conc / serial:.2f}x)")
