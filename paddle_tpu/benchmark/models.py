@@ -90,21 +90,29 @@ def _mnist(name, batch_size, dtype, mesh, strategy, rules, min_time):
 
 
 def _transformer(name, batch_size, dtype, mesh, strategy, rules, min_time,
-                 seq_len: int = 256, vocab: int = 32000):
+                 seq_len: int = 256, vocab: int = 32000,
+                 fused_qkv: bool = False, raw_ce: bool = False):
     """Transformer-base WMT (machine_translation.py / dist_transformer.py):
-    tokens/s on the teacher-forced train step."""
+    tokens/s on the teacher-forced train step.
+
+    fused_qkv / raw_ce are perf-variant knobs (tools/profile_transformer.py
+    A/B sweep): Megatron-packed projections, and feeding bf16 logits
+    straight to the internally-promoting CE instead of materializing an
+    f32 [B,T,V] copy first."""
     from paddle_tpu.models.transformer import Transformer
     bs = batch_size or 32
     model = Transformer(src_vocab=vocab, trg_vocab=vocab, model_dim=512,
                         num_heads=8, num_layers=6, ffn_dim=2048,
-                        dropout=0.0, max_len=seq_len + 1, dtype=dtype)
+                        dropout=0.0, max_len=seq_len + 1, dtype=dtype,
+                        fused_qkv=fused_qkv)
 
     def loss_fn(module, variables, batch, rng, training):
         src, trg_in, trg_out = batch
         logits, mut = module.apply(variables, src, trg_in, training=training,
                                    rngs=rng, mutable=True)
-        loss = jnp.mean(F.softmax_with_cross_entropy(
-            logits.astype(jnp.float32), trg_out))
+        if not raw_ce:
+            logits = logits.astype(jnp.float32)
+        loss = jnp.mean(F.softmax_with_cross_entropy(logits, trg_out))
         return (loss, {}), mut.get("state", {})
 
     trainer = _trainer_for(model, loss_fn, Adam(1e-4), mesh, strategy, rules)
@@ -143,7 +151,7 @@ def _stacked_lstm(name, batch_size, dtype, mesh, strategy, rules, min_time,
 def _bert(name, batch_size, dtype, mesh, strategy, rules, min_time,
           seq_len: int = 128, vocab: int = 30522, model_dim: int = 768,
           num_layers: int = 12, num_heads: int = 12, ffn_dim: int = 3072,
-          mask_frac: float = 0.15):
+          mask_frac: float = 0.15, fused_qkv: bool = False):
     """BERT-base MLM pretraining step (BASELINE BERT row: pod-scale
     allreduce / 8->32 chip scaling). Static masked-position count keeps
     the step one compile."""
@@ -153,7 +161,7 @@ def _bert(name, batch_size, dtype, mesh, strategy, rules, min_time,
     model = BertEncoder(vocab=vocab, model_dim=model_dim,
                         num_heads=num_heads, num_layers=num_layers,
                         ffn_dim=ffn_dim, max_len=seq_len, dropout=0.0,
-                        dtype=dtype)
+                        dtype=dtype, fused_qkv=fused_qkv)
 
     def loss_fn(module, variables, batch, rng, training):
         tokens, positions, labels = batch
@@ -242,12 +250,12 @@ MODELS = _registry()
 
 def run_model(name: str, batch_size: Optional[int] = None,
               dtype=jnp.float32, mesh=None, strategy=None, rules=None,
-              min_time: float = 2.0) -> BenchResult:
+              min_time: float = 2.0, **model_kwargs) -> BenchResult:
     if name not in MODELS:
         raise ValueError(f"unknown benchmark model {name!r}; "
                          f"choose from {sorted(MODELS)}")
     return MODELS[name](name, batch_size, dtype, mesh, strategy, rules,
-                        min_time)
+                        min_time, **model_kwargs)
 
 
 # Published reference INFERENCE numbers (BASELINE.md: Xeon E5-2650v4,

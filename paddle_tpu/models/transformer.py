@@ -45,18 +45,35 @@ def sinusoid_position_encoding(maxlen: int, dim: int) -> jnp.ndarray:
 
 
 class MultiHeadAttention(Module):
-    """MHA with optional KV cache; names match transformer_tp_rules."""
+    """MHA with optional KV cache; names match transformer_tp_rules.
+
+    fused_qkv=True packs the projections into one matmul (self-attention:
+    [D, 3D] "qkv"; cross-attention: "q_proj" + packed [D, 2D] "kv") — the
+    Megatron packing: fewer, wider matmuls tile the MXU better and halve
+    dispatch count. Packing is HEAD-MAJOR (columns ordered [head, role,
+    head_dim], role = q/k/v) so column-sharding the packed dim over tp
+    keeps every head's q, k AND v on the same shard — a contiguous
+    [q|k|v] layout would put all of q on the first shards and force
+    resharding collectives at the split. Checkpoints are NOT
+    interchangeable between fused and unfused layouts; default stays
+    unfused."""
 
     def __init__(self, model_dim: int, num_heads: int, dropout: float = 0.1,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, fused_qkv: bool = False):
         super().__init__()
         assert model_dim % num_heads == 0
         self.model_dim = model_dim
         self.num_heads = num_heads
         self.head_dim = model_dim // num_heads
-        self.q_proj = Linear(model_dim, dtype=dtype)
-        self.k_proj = Linear(model_dim, dtype=dtype)
-        self.v_proj = Linear(model_dim, dtype=dtype)
+        self.fused_qkv = fused_qkv
+        if fused_qkv:
+            self.qkv = Linear(3 * model_dim, dtype=dtype)
+            self.q_proj = Linear(model_dim, dtype=dtype)   # cross-attn q
+            self.kv = Linear(2 * model_dim, dtype=dtype)   # cross-attn kv
+        else:
+            self.q_proj = Linear(model_dim, dtype=dtype)
+            self.k_proj = Linear(model_dim, dtype=dtype)
+            self.v_proj = Linear(model_dim, dtype=dtype)
         self.out_proj = Linear(model_dim, dtype=dtype)
         self.drop = Dropout(dropout)
         self.dtype = dtype
@@ -73,9 +90,21 @@ class MultiHeadAttention(Module):
         (a dense causal mask would force the XLA reference path).
         cache: {"k","v"} [B, Tmax, H, Hd] updated at decode_pos."""
         kv_in = q if kv is None else kv
-        qh = self._split(self.q_proj(cx, q))
-        kh = self._split(self.k_proj(cx, kv_in))
-        vh = self._split(self.v_proj(cx, kv_in))
+        if self.fused_qkv and kv is None:
+            b, t = q.shape[:2]
+            x = self.qkv(cx, q).reshape(          # head-major: [H, 3, hd]
+                b, t, self.num_heads, 3, self.head_dim)
+            qh, kh, vh = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+        elif self.fused_qkv:
+            qh = self._split(self.q_proj(cx, q))
+            b, t = kv_in.shape[:2]
+            x = self.kv(cx, kv_in).reshape(
+                b, t, self.num_heads, 2, self.head_dim)
+            kh, vh = x[..., 0, :], x[..., 1, :]
+        else:
+            qh = self._split(self.q_proj(cx, q))
+            kh = self._split(self.k_proj(cx, kv_in))
+            vh = self._split(self.v_proj(cx, kv_in))
 
         if cache is not None:
             # incremental decode: write this step's k/v at decode_pos
@@ -112,9 +141,10 @@ class FeedForward(Module):
 
 class EncoderLayer(Module):
     def __init__(self, model_dim, num_heads, ffn_dim, dropout=0.1,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, fused_qkv=False):
         super().__init__()
-        self.attn = MultiHeadAttention(model_dim, num_heads, dropout, dtype)
+        self.attn = MultiHeadAttention(model_dim, num_heads, dropout, dtype,
+                                       fused_qkv=fused_qkv)
         self.ffn = FeedForward(model_dim, ffn_dim, dropout, dtype)
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
@@ -129,12 +159,12 @@ class EncoderLayer(Module):
 
 class DecoderLayer(Module):
     def __init__(self, model_dim, num_heads, ffn_dim, dropout=0.1,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, fused_qkv=False):
         super().__init__()
         self.self_attn = MultiHeadAttention(model_dim, num_heads, dropout,
-                                            dtype)
+                                            dtype, fused_qkv=fused_qkv)
         self.cross_attn = MultiHeadAttention(model_dim, num_heads, dropout,
-                                             dtype)
+                                             dtype, fused_qkv=fused_qkv)
         self.ffn = FeedForward(model_dim, ffn_dim, dropout, dtype)
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
@@ -161,7 +191,8 @@ class Transformer(Module):
     def __init__(self, src_vocab: int, trg_vocab: int, model_dim: int = 512,
                  num_heads: int = 8, num_layers: int = 6, ffn_dim: int = 2048,
                  dropout: float = 0.1, max_len: int = 1024,
-                 tie_embeddings: bool = False, dtype=jnp.float32):
+                 tie_embeddings: bool = False, dtype=jnp.float32,
+                 fused_qkv: bool = False):
         super().__init__()
         self.model_dim = model_dim
         self.max_len = max_len
@@ -170,10 +201,10 @@ class Transformer(Module):
         self.trg_embed = (self.src_embed if tie_embeddings
                           else Embedding(trg_vocab, model_dim, dtype=dtype))
         self.enc_layers = [EncoderLayer(model_dim, num_heads, ffn_dim,
-                                        dropout, dtype)
+                                        dropout, dtype, fused_qkv)
                            for _ in range(num_layers)]
         self.dec_layers = [DecoderLayer(model_dim, num_heads, ffn_dim,
-                                        dropout, dtype)
+                                        dropout, dtype, fused_qkv)
                            for _ in range(num_layers)]
         self.enc_ln = LayerNorm()
         self.dec_ln = LayerNorm()
@@ -254,14 +285,15 @@ class BertEncoder(Module):
     def __init__(self, vocab: int = 30522, model_dim: int = 768,
                  num_heads: int = 12, num_layers: int = 12,
                  ffn_dim: int = 3072, max_len: int = 512,
-                 dropout: float = 0.1, dtype=jnp.float32):
+                 dropout: float = 0.1, dtype=jnp.float32,
+                 fused_qkv: bool = False):
         super().__init__()
         self.model_dim = model_dim
         self.dtype = dtype
         self.embed = Embedding(vocab, model_dim, dtype=dtype)
         self.pos_embed = Embedding(max_len, model_dim, dtype=dtype)
         self.layers = [EncoderLayer(model_dim, num_heads, ffn_dim,
-                                    dropout, dtype)
+                                    dropout, dtype, fused_qkv)
                        for _ in range(num_layers)]
         self.ln = LayerNorm()
         self.drop = Dropout(dropout)

@@ -122,7 +122,7 @@ def transformer_tp_rules(tp_axis: str = "tp",
     - everything else fsdp-sharded or replicated.
     """
     return ShardingRules([
-        (r"(q_proj|k_proj|v_proj|qkv)/weight$", (None, tp_axis)),
+        (r"(q_proj|k_proj|v_proj|qkv|kv)/weight$", (None, tp_axis)),
         (r"(out_proj|o_proj)/weight$", (tp_axis, None)),
         (r"(fc1|w_in|up|gate)/weight$", (None, tp_axis)),
         (r"(fc2|w_out|down)/weight$", (tp_axis, None)),

@@ -176,3 +176,66 @@ def test_reference_attention_softmax_property(rng):
     # attention output is a convex combination: bounded by v extremes
     assert float(jnp.max(out)) <= float(jnp.max(v)) + 1e-4
     assert float(jnp.min(out)) >= float(jnp.min(v)) - 1e-4
+
+
+def test_fused_qkv_matches_unfused(rng):
+    """Megatron-packed projections are a pure re-layout: stitching the
+    unfused q/k/v (and cross-attn k/v) weights into the packed params
+    must reproduce the unfused logits exactly."""
+    kw = dict(src_vocab=31, trg_vocab=37, model_dim=32, num_heads=4,
+              num_layers=2, ffn_dim=64, dropout=0.0, max_len=16)
+    base = Transformer(**kw)
+    fused = Transformer(**kw, fused_qkv=True)
+    src = jnp.asarray(rng.randint(0, 31, (2, 9)))
+    trg = jnp.asarray(rng.randint(0, 37, (2, 7)))
+    vb = base.init(0, src, trg)
+    vf = fused.init(1, src, trg)
+
+    H, HD = 4, 8    # num_heads, head_dim of the tiny model
+
+    def pack(names, part):
+        """Head-major packing: columns ordered [head, role, head_dim]."""
+        mats = [np.asarray(attn_cur[n][part]) for n in names]
+        # [..., D] -> [..., H, HD] per role; stack roles on a new axis
+        per = [m.reshape(m.shape[:-1] + (H, HD)) for m in mats]
+        stacked = np.stack(per, axis=-2)        # [..., H, R, HD]
+        return jnp.asarray(
+            stacked.reshape(stacked.shape[:-3] + (H * len(names) * HD,)))
+
+    def stitch(attn, fattn, cross):
+        nonlocal attn_cur
+        attn_cur = attn
+        if cross:
+            fattn["q_proj"] = attn["q_proj"]
+            fattn["kv"] = {"weight": pack(("k_proj", "v_proj"), "weight"),
+                           "bias": pack(("k_proj", "v_proj"), "bias")}
+        else:
+            fattn["qkv"] = {
+                "weight": pack(("q_proj", "k_proj", "v_proj"), "weight"),
+                "bias": pack(("q_proj", "k_proj", "v_proj"), "bias")}
+        fattn["out_proj"] = attn["out_proj"]
+
+    attn_cur = None
+
+    pb, pf = vb["params"], jax.tree.map(lambda x: x, vf["params"])
+    for k in pb:
+        if k.startswith("enc_layers_"):
+            pf[k] = dict(pf[k])
+            stitch(pb[k]["attn"], pf[k].setdefault("attn", {}), False)
+            pf[k]["ffn"], pf[k]["ln1"], pf[k]["ln2"] = (
+                pb[k]["ffn"], pb[k]["ln1"], pb[k]["ln2"])
+        elif k.startswith("dec_layers_"):
+            pf[k] = dict(pf[k])
+            stitch(pb[k]["self_attn"], pf[k].setdefault("self_attn", {}),
+                   False)
+            stitch(pb[k]["cross_attn"], pf[k].setdefault("cross_attn", {}),
+                   True)
+            for sub in ("ffn", "ln1", "ln2", "ln3"):
+                pf[k][sub] = pb[k][sub]
+        else:
+            pf[k] = pb[k]
+
+    out_b = base.apply({"params": pb}, src, trg)
+    out_f = fused.apply({"params": pf}, src, trg)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_b),
+                               rtol=1e-5, atol=1e-5)
