@@ -13,7 +13,11 @@ Usage: python tools/profile_transformer.py [--bs 64] [--seq 256]
 
 import argparse
 import itertools
+import os
 import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
 
 import jax
 
@@ -72,7 +76,7 @@ def main():
         import tempfile
 
         from paddle_tpu.profiler.device_trace import op_table
-        for label in ("baseline", best):
+        for label in dict.fromkeys(("baseline", best)):
             fused = "fused_qkv" in label
             raw = "raw_ce" in label
             d = tempfile.mkdtemp(prefix=f"xf_{label.replace('+', '_')}_")
