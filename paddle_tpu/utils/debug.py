@@ -85,10 +85,16 @@ def op_census(fn: Callable, *args, stage: str = "optimized",
     over ProgramDesc ops, here over the HLO/StableHLO that actually runs;
     useful for spotting fusion regressions or unexpected op explosions).
     """
+    return census_from_text(dump_hlo(fn, *args, stage=stage,
+                                     static_argnums=static_argnums,
+                                     **kwargs))
+
+
+def census_from_text(text: str) -> Dict[str, int]:
+    """op_census over already-lowered HLO/StableHLO text (e.g. a
+    Compiled.as_text() the caller is holding anyway)."""
     import re
 
-    text = dump_hlo(fn, *args, stage=stage,
-                    static_argnums=static_argnums, **kwargs)
     counts: Dict[str, int] = {}
     # HLO: "%name = <type> opcode(...)" where <type> may be a tuple
     # "(s32[], f32[8,8]{1,0:T(8,128)})" — the opcode is the first

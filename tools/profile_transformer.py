@@ -21,6 +21,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import jax
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # env alone is not enough once sitecustomize pre-imported jax for the
+    # tunnel (conftest.py documents the mechanism)
+    jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     ap = argparse.ArgumentParser()
