@@ -135,7 +135,8 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                     consume_fn: Callable[[Pytree, jax.Array, jax.Array],
                                          jax.Array],
                     mesh: Mesh, axis: str = "pp",
-                    batch_axes: Sequence[str] = ()):
+                    batch_axes: Sequence[str] = (),
+                    param_specs: Optional[Pytree] = None):
     """Build fn(stacked_params, aux_params, xs, ys) -> mean scalar loss.
 
     The full streaming pipeline: inputs arrive via the strided conveyor,
@@ -145,6 +146,13 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
     exceeds the O(batch/S) input shard plus one activation. `batch_axes`
     lists mesh axes the microbatch dim is data-parallel over (the loss is
     pmean'd across them; grads flow through the psum transposes).
+
+    `param_specs` (a PartitionSpec pytree over stacked_params, default
+    P(axis) everywhere) lets stage weights shard over FURTHER mesh axes —
+    tensor parallelism inside each stage: the stage_fn then sees
+    tp-sliced weight shards and is responsible for its own tp psums
+    (see `lm_block(tp_axis=...)`). Activations stay replicated across
+    tp, so the conveyor/loss plumbing is unchanged.
     """
     baxes = tuple(batch_axes)
 
@@ -190,7 +198,8 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                 loss = lax.pmean(loss, baxes)  # data-parallel mean
             return loss
 
-        in_specs = (P(axis), P(),
+        in_specs = (param_specs if param_specs is not None else P(axis),
+                    P(),
                     P(None, axis, baxes if baxes else None),
                     P(None, axis, baxes if baxes else None))
         return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
@@ -229,24 +238,35 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
-def lm_block(p: Pytree, x: jax.Array, n_heads: int) -> jax.Array:
+def lm_block(p: Pytree, x: jax.Array, n_heads: int,
+             tp_axis: Optional[str] = None) -> jax.Array:
     """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
-    [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked params."""
+    [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked params.
+
+    qkv columns are packed HEAD-MAJOR ([head, role, head_dim]), so with
+    `tp_axis` the weights arrive column-sliced to whole heads (w_qkv/w1
+    split on their output dim, w_o/w2 on their input dim — Megatron
+    column/row parallelism) and the block closes each sub-layer with one
+    psum over tp. Activations are replicated across tp throughout."""
     b, t, d = x.shape
     hd = d // n_heads
+
+    def maybe_psum(v):
+        return lax.psum(v, tp_axis) if tp_axis is not None else v
+
     h = _layernorm(x, p["ln1_s"], p["ln1_b"])
-    qkv = h @ p["w_qkv"]                                    # [mb,T,3D]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, t, n_heads, hd)
-    k = k.reshape(b, t, n_heads, hd)
-    v = v.reshape(b, t, n_heads, hd)
+    qkv = h @ p["w_qkv"]                        # [mb,T,3D/tp] local heads
+    local_heads = qkv.shape[-1] // (3 * hd)
+    qkv = qkv.reshape(b, t, local_heads, 3, hd)
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
     mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
     s = jnp.where(mask[None, None], s, -1e30)
     o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
-    x = x + o.reshape(b, t, d) @ p["w_o"]
+    x = x + maybe_psum(o.reshape(b, t, local_heads * hd) @ p["w_o"])
     h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
-    return x + jax.nn.relu(h2 @ p["w1"] + p["b1"]) @ p["w2"] + p["b2"]
+    up = jax.nn.relu(h2 @ p["w1"] + p["b1"])    # [mb,T,F/tp]
+    return x + maybe_psum(up @ p["w2"]) + p["b2"]
 
 
 class PipelinedLM(Module):
@@ -305,24 +325,52 @@ class PipelinedLM(Module):
         return _layernorm(x, lnf_s, lnf_b) @ head
 
 
-def pipeline_rules(axis: str = "pp"):
+def _stage_specs(axis: str, tp_axis: Optional[str]):
+    """PartitionSpecs for PipelinedLM's stacked stage params: dim 0 over
+    the pp axis, plus Megatron column/row splits over tp when given."""
+    if tp_axis is None:
+        return P(axis)          # prefix: every leaf P(axis)
+    return {"w_qkv": P(axis, None, tp_axis), "w_o": P(axis, tp_axis, None),
+            "w1": P(axis, None, tp_axis), "b1": P(axis, tp_axis),
+            "w2": P(axis, tp_axis, None), "b2": P(axis),
+            "ln1_s": P(axis), "ln1_b": P(axis),
+            "ln2_s": P(axis), "ln2_b": P(axis)}
+
+
+def pipeline_rules(axis: str = "pp", tp_axis: Optional[str] = None):
     """Sharding rules for PipelinedLM (+ its optimizer slots): stage
-    stacks over `axis`, everything else replicated."""
+    stacks over `axis`; with `tp_axis`, stage matmul weights additionally
+    split Megatron-style (w_qkv/w1/b1 on the output dim, w_o/w2 on the
+    input dim); embed/pos/head replicated."""
     from paddle_tpu.parallel.sharding import ShardingRules
-    return ShardingRules([(r"(^|/)stages/", (axis,))])
+    if tp_axis is None:
+        return ShardingRules([(r"(^|/)stages/", (axis,))])
+    specs = _stage_specs(axis, tp_axis)
+    return ShardingRules(
+        [(rf"(^|/)stages/{name}$", tuple(spec))
+         for name, spec in specs.items()]
+        + [(r"(^|/)stages/", (axis,))])
 
 
 def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                       num_microbatches: Optional[int] = None,
-                      batch_axes: Sequence[str] = ("dp",)):
+                      batch_axes: Sequence[str] = ("dp",),
+                      tp_axis: Optional[str] = None):
     """MeshTrainer loss_fn training PipelinedLM through the pipeline.
 
     batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
     (default 2·S) divides B. Embedding runs before the pipeline,
-    head + cross-entropy stream inside it on the last stage.
+    head + cross-entropy stream inside it on the last stage (computed
+    redundantly per tp member — head stays replicated).
+
+    With `tp_axis`, stage weights shard Megatron-style inside each
+    pipeline stage (pp×tp×dp 3D parallelism); pair with
+    `pipeline_rules(axis, tp_axis)` so the TrainState matches.
     """
     from paddle_tpu.ops import functional as F
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
+    tp = tp_axis if tp_axis is not None and mesh.shape.get(tp_axis, 1) > 1 \
+        else None
 
     def loss_fn(module, variables, batch, rng, training):
         tok_in, tok_out = batch
@@ -333,6 +381,12 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
         if b % m:
             raise ValueError(
                 f"microbatch count {m} must divide batch size {b}")
+        if tp is not None:
+            nt = mesh.shape[tp]
+            if module.n_heads % nt or module.d_ff % nt:
+                raise ValueError(
+                    f"tp={nt} must divide n_heads ({module.n_heads}) "
+                    f"and d_ff ({module.d_ff})")
 
         h = p["embed"][tok_in] + p["pos"][:t]
         xs = h.reshape((m, b // m) + h.shape[1:])
@@ -345,8 +399,9 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                 logits.astype(jnp.float32), tgt_mb))
 
         stream = pipeline_stream(
-            partial(lm_block, n_heads=module.n_heads), consume, mesh,
-            axis, batch_axes=baxes)
+            partial(lm_block, n_heads=module.n_heads, tp_axis=tp),
+            consume, mesh, axis, batch_axes=baxes,
+            param_specs=_stage_specs(axis, tp) if tp else None)
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
                       xs, ys)
         return (loss, {}), {}

@@ -1,0 +1,17 @@
+"""Shared bootstrap for repo tools: `import _bootstrap  # noqa` first.
+
+Puts the repo root on sys.path (the package is not pip-installed) and
+applies the JAX cpu-override workaround: under the tunnel sitecustomize,
+jax is pre-imported, so JAX_PLATFORMS=cpu alone is ignored — the config
+must be updated too (tests/conftest.py documents the mechanism)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import jax  # noqa: E402
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")

@@ -17,18 +17,9 @@ Usage: python tools/profile_resnet_tail.py [--bs 128] [--min-time 2.5]
 """
 
 import argparse
-import os
-import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                os.pardir))
-
+import _bootstrap  # noqa: F401  (repo path + JAX cpu-override workaround)
 import jax
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # env alone is not enough once sitecustomize pre-imported jax for the
-    # tunnel (conftest.py documents the mechanism)
-    jax.config.update("jax_platforms", "cpu")
 
 
 def main():
@@ -55,7 +46,7 @@ def main():
     model = V.resnet50(1000, dtype=jnp.bfloat16)
     rs = np.random.RandomState(0)
     x = jnp.asarray(rs.randn(bs, img, img, 3), jnp.float32)
-    y = jnp.asarray(rs.randint(0, 1000, bs), jnp.int64)
+    y = jnp.asarray(rs.randint(0, 1000, bs), jnp.int32)
     variables = model.init(jax.random.key(0), x)
     momentum = jax.tree.map(jnp.zeros_like, variables["params"])
     # host snapshot: each variant donates its own device copy

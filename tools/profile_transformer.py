@@ -13,18 +13,10 @@ Usage: python tools/profile_transformer.py [--bs 64] [--seq 256]
 
 import argparse
 import itertools
-import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                os.pardir))
-
+import _bootstrap  # noqa: F401  (repo path + JAX cpu-override workaround)
 import jax
-
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # env alone is not enough once sitecustomize pre-imported jax for the
-    # tunnel (conftest.py documents the mechanism)
-    jax.config.update("jax_platforms", "cpu")
 
 
 def main():
