@@ -9,12 +9,13 @@ from paddle_tpu.parallel.distributed import (
     init_distributed, process_count, process_index,
 )
 from paddle_tpu.parallel.pipeline import (
-    PipelinedLM, pipeline_apply, pipeline_loss_fn, pipeline_rules,
-    pipeline_stream, pipelined_lm_loss, stack_stage_params,
+    PipelinedLM, PipelinedMoELM, pipeline_apply, pipeline_loss_fn,
+    pipeline_moe_rules, pipeline_rules, pipeline_stream, pipelined_lm_loss,
+    pipelined_moe_lm_loss, stack_stage_params,
 )
 from paddle_tpu.parallel.moe import (
     init_moe_params, load_balancing_loss, moe_ffn, moe_ffn_a2a,
-    moe_partition_specs,
+    moe_ffn_local, moe_partition_specs,
 )
 from paddle_tpu.parallel.ring import (
     ring_attention, ring_flash_attention, ulysses_attention, zigzag_shard,

@@ -199,6 +199,58 @@ def moe_ffn_a2a(params: Dict[str, jax.Array], x: jax.Array, mesh: Mesh,
                "capacity": jnp.asarray(cap)}
 
 
+def moe_ffn_local(params: Dict[str, jax.Array], x: jax.Array,
+                  axis: Optional[str] = None, k: int = 2,
+                  capacity_factor: float = 1.25
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Top-k MoE FFN for callers ALREADY inside a shard_map (e.g. a
+    pipeline stage body): `params["w1"]/["w2"]` are the local expert
+    slices ([E/n, ...]; full stacks when axis is None), `params["gate"]`
+    is replicated [D, E-total], and x [T, D] is replicated across `axis`.
+
+    Because activations are replicated, no all_to_all is needed: every
+    member routes identically, packs capacity-bounded buffers for ITS
+    experts only (compute O(k·cf·T/E · E/n), the same economics as
+    `moe_ffn_a2a`), and one psum over `axis` combines. Returns
+    (y [T, D] post-psum, aux with router_probs/expert_index/
+    load_balance/dropped_fraction) — `load_balance` is the Switch aux
+    loss, computed in-body so pipeline stages can surface it as their
+    stage-aux scalar.
+    """
+    e_local, d = params["w1"].shape[0], x.shape[-1]
+    e = params["gate"].shape[-1]
+    t_l = x.shape[0]
+    cap = max(1, math.ceil(t_l * k / e * capacity_factor))
+    probs, top_p, top_i, _, _ = _route(params["gate"], x, k)
+    flat_e = top_i.reshape(-1)
+    flat_p = top_p.reshape(-1).astype(x.dtype)
+    tok = jnp.repeat(jnp.arange(t_l), k)
+
+    # identical global position math on every member (x is replicated)
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+    keep = pos < cap
+
+    first = lax.axis_index(axis) * e_local if axis is not None else 0
+    mine = (flat_e >= first) & (flat_e < first + e_local)
+    le = jnp.clip(flat_e - first, 0, e_local - 1)
+    pos_c = jnp.where(keep & mine, pos, cap)     # OOB rows drop
+
+    buf = jnp.zeros((e_local, cap, d), x.dtype)
+    buf = buf.at[le, pos_c].add(x[tok], mode="drop")
+    h = jax.vmap(_expert_ffn)(params["w1"].astype(x.dtype),
+                              params["w2"].astype(x.dtype), buf)
+    slot_out = h[le, jnp.minimum(pos_c, cap - 1)] \
+        * (flat_p * (keep & mine))[:, None]
+    y = jnp.zeros_like(x).at[tok].add(slot_out)
+    if axis is not None:
+        y = lax.psum(y, axis)
+    aux = {"router_probs": probs, "expert_index": top_i[:, 0],
+           "dropped_fraction": jnp.mean(1.0 - keep.astype(jnp.float32))}
+    aux["load_balance"] = load_balancing_loss(aux)
+    return y, aux
+
+
 def load_balancing_loss(aux: Dict[str, jax.Array]) -> jax.Array:
     """Switch-transformer auxiliary loss: E * sum_e f_e * P_e, where f_e =
     fraction of tokens routed to e, P_e = mean router prob of e. Minimised

@@ -153,6 +153,11 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
     tp-sliced weight shards and is responsible for its own tp psums
     (see `lm_block(tp_axis=...)`). Activations stay replicated across
     tp, so the conveyor/loss plumbing is unchanged.
+
+    stage_fn may return `(y, stage_aux_scalar)` instead of `y`: the
+    scalar (e.g. an MoE load-balancing loss) is accumulated over every
+    VALID (stage, microbatch) pair — bubble ticks masked out — averaged,
+    and ADDED to the consume_fn loss.
     """
     baxes = tuple(batch_axes)
 
@@ -172,12 +177,19 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
             zero = jnp.zeros_like(xs_l[0])
 
             def tick(carry, t):
-                buf, acc = carry
+                buf, acc, sacc = carry
                 cand = xs_l[jnp.minimum(t, m - 1) // s]
                 x_in = lax.psum(
                     jnp.where((stage == t % s) & (t < m), cand, zero), axis)
                 x_t = jnp.where(stage == 0, x_in, buf)
-                y = stage_fn(params, x_t)
+                out = stage_fn(params, x_t)
+                y, stage_aux = out if isinstance(out, tuple) else (out, None)
+                if stage_aux is not None:
+                    # stage s holds a real microbatch at tick t iff
+                    # s <= t < s + m (bubble ticks carry junk)
+                    valid = (stage <= t) & (t < stage + m)
+                    sacc = sacc + jnp.where(
+                        valid, stage_aux.astype(jnp.float32), 0.0)
                 # microbatch j finished on the last stage this tick; its
                 # targets stream in from their strided owner the same way
                 j = t - (s - 1)
@@ -189,11 +201,15 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                 li = consume_fn(aux, y, tgt)
                 acc = acc + jnp.where((stage == s - 1) & (j >= 0),
                                       li.astype(jnp.float32), 0.0)
-                return (lax.ppermute(y, axis, fwd_perm), acc), None
+                return (lax.ppermute(y, axis, fwd_perm), acc, sacc), None
 
-            (_, acc), _ = lax.scan(
-                tick, (zero, jnp.zeros((), jnp.float32)), jnp.arange(total))
+            (_, acc, sacc), _ = lax.scan(
+                tick, (zero, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)), jnp.arange(total))
             loss = lax.psum(acc, axis) / m     # replicate across pp
+            # per-stage aux: mean over the s*m valid (stage, microbatch)
+            # pairs (each stage's sacc holds only its own contributions)
+            loss = loss + lax.psum(sacc, axis) / (s * m)
             if baxes:
                 loss = lax.pmean(loss, baxes)  # data-parallel mean
             return loss
@@ -238,22 +254,22 @@ def _layernorm(x, scale, bias, eps=1e-5):
     return (x - mu) * lax.rsqrt(var + eps) * scale + bias
 
 
-def lm_block(p: Pytree, x: jax.Array, n_heads: int,
-             tp_axis: Optional[str] = None) -> jax.Array:
-    """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
-    [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked params.
+def _maybe_psum(v, axis: Optional[str]):
+    return lax.psum(v, axis) if axis is not None else v
+
+
+def _attention(p: Pytree, x: jax.Array, n_heads: int,
+               tp_axis: Optional[str] = None) -> jax.Array:
+    """Pre-LN causal self-attention sub-layer WITH residual (shared by
+    lm_block and moe_lm_block — one home for the packing convention).
 
     qkv columns are packed HEAD-MAJOR ([head, role, head_dim]), so with
-    `tp_axis` the weights arrive column-sliced to whole heads (w_qkv/w1
-    split on their output dim, w_o/w2 on their input dim — Megatron
-    column/row parallelism) and the block closes each sub-layer with one
-    psum over tp. Activations are replicated across tp throughout."""
+    `tp_axis` the weights arrive column-sliced to whole heads (w_qkv on
+    its output dim, w_o on its input dim — Megatron column/row
+    parallelism) and the sub-layer closes with one psum over tp.
+    Activations are replicated across tp."""
     b, t, d = x.shape
     hd = d // n_heads
-
-    def maybe_psum(v):
-        return lax.psum(v, tp_axis) if tp_axis is not None else v
-
     h = _layernorm(x, p["ln1_s"], p["ln1_b"])
     qkv = h @ p["w_qkv"]                        # [mb,T,3D/tp] local heads
     local_heads = qkv.shape[-1] // (3 * hd)
@@ -263,10 +279,39 @@ def lm_block(p: Pytree, x: jax.Array, n_heads: int,
     mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
     s = jnp.where(mask[None, None], s, -1e30)
     o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
-    x = x + maybe_psum(o.reshape(b, t, local_heads * hd) @ p["w_o"])
+    return x + _maybe_psum(o.reshape(b, t, local_heads * hd) @ p["w_o"],
+                           tp_axis)
+
+
+def lm_block(p: Pytree, x: jax.Array, n_heads: int,
+             tp_axis: Optional[str] = None) -> jax.Array:
+    """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
+    [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked
+    params. See `_attention` for the tp packing contract; the FFN splits
+    w1/b1 on the output dim and w2 on the input dim the same way."""
+    x = _attention(p, x, n_heads, tp_axis)
     h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
     up = jax.nn.relu(h2 @ p["w1"] + p["b1"])    # [mb,T,F/tp]
-    return x + maybe_psum(up @ p["w2"]) + p["b2"]
+    return x + _maybe_psum(up @ p["w2"], tp_axis) + p["b2"]
+
+
+def moe_lm_block(p: Pytree, x: jax.Array, n_heads: int,
+                 ep_axis: Optional[str] = None, k: int = 2,
+                 capacity_factor: float = 2.0):
+    """lm_block with the dense FFN replaced by a top-k MoE FFN (GShard-
+    style MoE transformer layer). Returns (y, load_balance_scalar) —
+    pipeline_stream accumulates the scalar as stage-aux. Inside the
+    pipeline shard_map, expert stacks arrive pre-sliced over `ep_axis`
+    and `moe_ffn_local` handles dispatch + the combining psum."""
+    from paddle_tpu.parallel.moe import moe_ffn_local
+    b, t, d = x.shape
+    x = _attention(p, x, n_heads)
+    h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
+    y, aux = moe_ffn_local(
+        {"gate": p["gate"], "w1": p["moe_w1"], "w2": p["moe_w2"]},
+        h2.reshape(b * t, d), axis=ep_axis, k=k,
+        capacity_factor=capacity_factor)
+    return x + y.reshape(b, t, d), aux["load_balance"]
 
 
 class PipelinedLM(Module):
@@ -290,9 +335,20 @@ class PipelinedLM(Module):
         self.d_ff, self.num_stages, self.max_len = d_ff, num_stages, max_len
         self.dtype = dtype
 
+    def _ffn_params(self, sx: Context) -> dict:
+        """Per-stage FFN params (hook: PipelinedMoELM swaps in experts)."""
+        from paddle_tpu.nn import initializers as I
+        d, f, s, dt = self.d_model, self.d_ff, self.num_stages, self.dtype
+        return {
+            "w1": sx.param("w1", (s, d, f), I.xavier(), dt),
+            "b1": sx.param("b1", (s, f), I.constant(0.0), dt),
+            "w2": sx.param("w2", (s, f, d), I.xavier(), dt),
+            "b2": sx.param("b2", (s, d), I.constant(0.0), dt),
+        }
+
     def _params(self, cx: Context):
         from paddle_tpu.nn import initializers as I
-        v, d, f, s = self.vocab, self.d_model, self.d_ff, self.num_stages
+        v, d, s = self.vocab, self.d_model, self.num_stages
         dt = self.dtype
         emb = cx.param("embed", (v, d), I.normal(0.0, 0.02), dt)
         pos = cx.param("pos", (self.max_len, d), I.normal(0.0, 0.02), dt)
@@ -302,12 +358,9 @@ class PipelinedLM(Module):
             "w_o": sx.param("w_o", (s, d, d), I.xavier(), dt),
             "ln1_s": sx.param("ln1_s", (s, d), I.constant(1.0), dt),
             "ln1_b": sx.param("ln1_b", (s, d), I.constant(0.0), dt),
-            "w1": sx.param("w1", (s, d, f), I.xavier(), dt),
-            "b1": sx.param("b1", (s, f), I.constant(0.0), dt),
-            "w2": sx.param("w2", (s, f, d), I.xavier(), dt),
-            "b2": sx.param("b2", (s, d), I.constant(0.0), dt),
             "ln2_s": sx.param("ln2_s", (s, d), I.constant(1.0), dt),
             "ln2_b": sx.param("ln2_b", (s, d), I.constant(0.0), dt),
+            **self._ffn_params(sx),
         }
         lnf_s = cx.param("lnf_s", (d,), I.constant(1.0), dt)
         lnf_b = cx.param("lnf_b", (d,), I.constant(0.0), dt)
@@ -322,6 +375,48 @@ class PipelinedLM(Module):
             return lm_block(stage_p, x, self.n_heads), None
 
         x, _ = lax.scan(body, x, stages)        # scan over the stage dim
+        return _layernorm(x, lnf_s, lnf_b) @ head
+
+
+class PipelinedMoELM(PipelinedLM):
+    """PipelinedLM with every stage's dense FFN replaced by a top-k MoE
+    FFN (GShard-style MoE transformer): pp×ep×dp — pipeline stages over
+    pp, each stage's expert stack sharded over ep, batch over dp. Expert
+    dispatch inside a stage needs NO all_to_all (activations are
+    replicated across ep; see `moe_ffn_local`). `forward` is the dense
+    single-device computation over the same params (capacity math is
+    per-call, so exact parity with the pipelined path holds when
+    capacity_factor is ample)."""
+
+    def __init__(self, vocab: int, d_model: int = 64, n_heads: int = 4,
+                 d_ff: int = 128, num_stages: int = 4, max_len: int = 128,
+                 num_experts: int = 4, top_k: int = 2,
+                 capacity_factor: float = 2.0, dtype=jnp.float32):
+        super().__init__(vocab, d_model, n_heads, d_ff, num_stages,
+                         max_len, dtype)
+        self.num_experts, self.top_k = num_experts, top_k
+        self.capacity_factor = capacity_factor
+
+    def _ffn_params(self, sx: Context) -> dict:
+        from paddle_tpu.nn import initializers as I
+        d, f, s = self.d_model, self.d_ff, self.num_stages
+        e, dt = self.num_experts, self.dtype
+        return {
+            "gate": sx.param("gate", (s, d, e), I.normal(0.0, 0.02), dt),
+            "moe_w1": sx.param("moe_w1", (s, e, d, f), I.xavier(), dt),
+            "moe_w2": sx.param("moe_w2", (s, e, f, d), I.xavier(), dt),
+        }
+
+    def forward(self, cx: Context, tokens):
+        emb, pos, stages, lnf_s, lnf_b, head = self._params(cx)
+        x = emb[tokens] + pos[: tokens.shape[1]]
+
+        def body(x, stage_p):
+            y, _ = moe_lm_block(stage_p, x, self.n_heads, k=self.top_k,
+                                capacity_factor=self.capacity_factor)
+            return y, None
+
+        x, _ = lax.scan(body, x, stages)
         return _layernorm(x, lnf_s, lnf_b) @ head
 
 
@@ -342,14 +437,92 @@ def pipeline_rules(axis: str = "pp", tp_axis: Optional[str] = None):
     stacks over `axis`; with `tp_axis`, stage matmul weights additionally
     split Megatron-style (w_qkv/w1/b1 on the output dim, w_o/w2 on the
     input dim); embed/pos/head replicated."""
+    return _rules_from_specs(axis, _stage_specs(axis, tp_axis))
+
+
+def _moe_stage_specs(axis: str, ep_axis: Optional[str]):
+    """PartitionSpecs for PipelinedMoELM stage params: stage dim over pp,
+    expert stacks additionally over ep."""
+    if ep_axis is None:
+        return P(axis)
+    base = {name: P(axis) for name in ("w_qkv", "w_o", "ln1_s", "ln1_b",
+                                       "gate", "ln2_s", "ln2_b")}
+    base["moe_w1"] = P(axis, ep_axis)
+    base["moe_w2"] = P(axis, ep_axis)
+    return base
+
+
+def _rules_from_specs(axis: str, specs) -> "ShardingRules":
+    """ShardingRules derived from a stage-spec table (single source of
+    truth: the same dict drives shard_map in_specs AND TrainState
+    shardings, so the two can never disagree)."""
     from paddle_tpu.parallel.sharding import ShardingRules
-    if tp_axis is None:
-        return ShardingRules([(r"(^|/)stages/", (axis,))])
-    specs = _stage_specs(axis, tp_axis)
+    if not isinstance(specs, dict):
+        return ShardingRules([(r"(^|/)stages/", tuple(specs))])
     return ShardingRules(
         [(rf"(^|/)stages/{name}$", tuple(spec))
          for name, spec in specs.items()]
         + [(r"(^|/)stages/", (axis,))])
+
+
+def pipeline_moe_rules(axis: str = "pp", ep_axis: Optional[str] = "ep"):
+    """Sharding rules for PipelinedMoELM (+ optimizer slots): stage
+    stacks over `axis`, expert stacks additionally over `ep_axis`."""
+    return _rules_from_specs(axis, _moe_stage_specs(axis, ep_axis))
+
+
+def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
+                          num_microbatches: Optional[int] = None,
+                          batch_axes: Sequence[str] = ("dp",),
+                          ep_axis: Optional[str] = "ep",
+                          lb_weight: float = 0.01):
+    """MeshTrainer loss_fn training PipelinedMoELM: CE streamed on the
+    last stage + lb_weight × the Switch load-balance aux averaged over
+    every (stage, microbatch). Expert stacks shard over `ep_axis`
+    (pp×ep×dp); pair with `pipeline_moe_rules(axis, ep_axis)`.
+    """
+    from paddle_tpu.ops import functional as F
+    baxes = tuple(a for a in batch_axes if a in mesh.shape)
+    ep = ep_axis if ep_axis is not None and mesh.shape.get(ep_axis, 1) > 1 \
+        else None
+
+    def loss_fn(module, variables, batch, rng, training):
+        tok_in, tok_out = batch
+        p = variables[PARAMS]
+        s = mesh.shape[axis]
+        m = num_microbatches or 2 * s
+        b, t = tok_in.shape
+        if b % m:
+            raise ValueError(
+                f"microbatch count {m} must divide batch size {b}")
+        if ep is not None and module.num_experts % mesh.shape[ep]:
+            raise ValueError(
+                f"ep={mesh.shape[ep]} must divide num_experts "
+                f"({module.num_experts})")
+
+        h = p["embed"][tok_in] + p["pos"][:t]
+        xs = h.reshape((m, b // m) + h.shape[1:])
+        ys = tok_out.reshape((m, b // m) + tok_out.shape[1:])
+
+        def stage(sp, x):
+            y, lb = moe_lm_block(sp, x, module.n_heads, ep_axis=ep,
+                                 k=module.top_k,
+                                 capacity_factor=module.capacity_factor)
+            return y, lb_weight * lb
+
+        def consume(aux, y_mb, tgt_mb):
+            lnf_s, lnf_b, head = aux
+            logits = _layernorm(y_mb, lnf_s, lnf_b) @ head
+            return jnp.mean(F.softmax_with_cross_entropy(
+                logits.astype(jnp.float32), tgt_mb))
+
+        stream = pipeline_stream(
+            stage, consume, mesh, axis, batch_axes=baxes,
+            param_specs=_moe_stage_specs(axis, ep))
+        loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
+                      xs, ys)
+        return (loss, {}), {}
+    return loss_fn
 
 
 def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",

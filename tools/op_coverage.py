@@ -23,11 +23,10 @@ Run: python tools/op_coverage.py  (writes OPS_COVERAGE.md, prints summary;
 from __future__ import annotations
 
 import importlib
-import os
 import sys
 from collections import Counter
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import _bootstrap  # noqa: F401  (repo path + JAX cpu-override workaround)
 
 # (ref_op, status, paddle_tpu symbol or rationale)
 TABLE = [
