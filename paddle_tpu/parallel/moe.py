@@ -128,6 +128,27 @@ def moe_ffn(params: Dict[str, jax.Array], x: jax.Array,
     return y, {"router_probs": probs, "expert_index": top_i[:, 0]}
 
 
+def _route_slots(gate, x, k: int, cap: int):
+    """Shared capacity-dispatch bookkeeping for the a2a and local paths:
+    top-k route, slot flattening, per-expert cumsum positions, and the
+    keep mask (pos < cap). One home for the capacity convention, so the
+    documented exact-parity between dispatch paths cannot drift.
+
+    Returns (probs [T,E], top_i [T,k], flat_e [T·k], flat_p [T·k],
+    tok [T·k] slot→token row, pos [T·k] position within expert,
+    keep [T·k] bool)."""
+    e = gate.shape[-1]
+    t = x.shape[0]
+    probs, top_p, top_i, _, _ = _route(gate, x, k)
+    flat_e = top_i.reshape(-1)
+    flat_p = top_p.reshape(-1).astype(x.dtype)
+    tok = jnp.repeat(jnp.arange(t), k)
+    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+    keep = pos < cap
+    return probs, top_i, flat_e, flat_p, tok, pos, keep
+
+
 def moe_ffn_a2a(params: Dict[str, jax.Array], x: jax.Array, mesh: Mesh,
                 axis: str = "ep", k: int = 2, capacity_factor: float = 1.25
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
@@ -157,15 +178,8 @@ def moe_ffn_a2a(params: Dict[str, jax.Array], x: jax.Array, mesh: Mesh,
 
     def local(gate, w1_l, w2_l, x_l):
         # x_l: [T/n, D] this device's tokens
-        probs, top_p, top_i, _, _ = _route(gate, x_l, k)
-        flat_e = top_i.reshape(-1)                        # [T/n · k]
-        flat_p = top_p.reshape(-1).astype(x_l.dtype)
-        tok = jnp.repeat(jnp.arange(t_l), k)              # slot → token row
-
-        # position of each slot within its expert's send buffer
-        onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)   # [T/n·k, E]
-        pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
-        keep = pos < cap
+        probs, top_i, flat_e, flat_p, tok, pos, keep = _route_slots(
+            gate, x_l, k, cap)
         # OOB rows (dropped tokens) fall out via scatter mode="drop"
         pos_c = jnp.where(keep, pos, cap)
 
@@ -221,15 +235,9 @@ def moe_ffn_local(params: Dict[str, jax.Array], x: jax.Array,
     e = params["gate"].shape[-1]
     t_l = x.shape[0]
     cap = max(1, math.ceil(t_l * k / e * capacity_factor))
-    probs, top_p, top_i, _, _ = _route(params["gate"], x, k)
-    flat_e = top_i.reshape(-1)
-    flat_p = top_p.reshape(-1).astype(x.dtype)
-    tok = jnp.repeat(jnp.arange(t_l), k)
-
-    # identical global position math on every member (x is replicated)
-    onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)
-    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
-    keep = pos < cap
+    # identical global slot math on every member (x is replicated)
+    probs, top_i, flat_e, flat_p, tok, pos, keep = _route_slots(
+        params["gate"], x, k, cap)
 
     first = lax.axis_index(axis) * e_local if axis is not None else 0
     mine = (flat_e >= first) & (flat_e < first + e_local)

@@ -4,9 +4,11 @@ The reference has no pipeline parallelism (SURVEY §2.6 "not present");
 this is a TPU-native extension completing the advertised mesh axes
 (parallel/mesh.py "pp"). Design follows the SPMD pipeline idiom:
 
-- The model is S identical-shape stages. Per-stage parameters are stacked
-  on a leading dim sharded over the pp axis, so each device holds exactly
-  its own stage's weights (the shard_map body sees a [1, ...] slice).
+- The model is S_total identical-shape stages. Per-stage parameters are
+  stacked on a leading dim sharded over the pp axis (size S), so each
+  device holds v = S_total/S consecutive stages ("virtual stages",
+  chained inside one tick) — models deeper than the axis pipeline
+  without restriction.
 - Microbatches stream through a lax.scan over M + S - 1 ticks. At tick t,
   stage s computes microbatch (t - s); activations hop one stage per tick
   via a single `ppermute` over ICI. Bubble fraction is the standard
@@ -51,16 +53,29 @@ def stack_stage_params(per_stage: Sequence[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)
 
 
-def _check_stages(stacked_params: Pytree, s: int, axis: str) -> None:
-    """The stage stack must match the mesh axis 1:1 — each device holds
-    exactly one stage's slice; a mismatch would silently run only the
-    first S_mesh stages."""
+def _check_stages(stacked_params: Pytree, s: int, axis: str) -> int:
+    """The stage stack must divide evenly onto the mesh axis: each device
+    holds v = S_total/S_mesh consecutive stages ("virtual stages",
+    chained per tick), so models deeper than the axis still pipeline.
+    Returns v. A non-divisible stack would silently drop stages."""
     leaves = jax.tree.leaves(stacked_params)
-    if leaves and leaves[0].shape[0] != s:
+    if leaves and leaves[0].shape[0] % s:
         raise ValueError(
-            f"stacked stage dim {leaves[0].shape[0]} != mesh '{axis}' size "
-            f"{s}; pipeline stages must map 1:1 onto the axis (run the "
-            "dense forward instead when unsharded)")
+            f"stacked stage dim {leaves[0].shape[0]} must be a multiple "
+            f"of mesh '{axis}' size {s} (v consecutive stages per device)")
+    return leaves[0].shape[0] // s if leaves else 1
+
+
+def _chain_stages(stage_fn: Callable, params_v: Pytree, x: jax.Array):
+    """Apply this device's v stacked stage slices in order (scan over the
+    local virtual-stage dim — one tick's compute)."""
+    def body(h, sp):
+        out = stage_fn(sp, h)
+        if isinstance(out, tuple):
+            return out[0], out[1].astype(jnp.float32)
+        return out, jnp.zeros((), jnp.float32)
+    y, auxes = lax.scan(body, x, params_v)
+    return y, jnp.sum(auxes)
 
 
 def _strided(xs: jax.Array, s: int) -> Tuple[jax.Array, int]:
@@ -79,14 +94,15 @@ def _strided(xs: jax.Array, s: int) -> Tuple[jax.Array, int]:
 def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                    stacked_params: Pytree, microbatches: jax.Array,
                    mesh: Mesh, axis: str = "pp"):
-    """Run S pipeline stages over M microbatches.
+    """Run the stacked pipeline stages over M microbatches.
 
     stage_fn(params, x) -> y with y.shape == x.shape (equal-width stages —
-    the usual transformer-block case). stacked_params: leading dim S
-    sharded over `axis`. microbatches: [M, mb, ...]; resident per-device
-    input is the strided O(M/S) shard. Returns [M, mb, ...] outputs
-    (replicated — use `pipeline_stream` to avoid materialising them),
-    differentiable end to end.
+    the usual transformer-block case). stacked_params: leading dim any
+    MULTIPLE of the `axis` size (each device chains its v = S_total/S
+    consecutive virtual stages per tick). microbatches: [M, mb, ...];
+    resident per-device input is the strided O(M/S) shard. Returns
+    [M, mb, ...] outputs (replicated — use `pipeline_stream` to avoid
+    materialising them), differentiable end to end.
     """
     s = mesh.shape[axis]
     _check_stages(stacked_params, s, axis)
@@ -97,8 +113,8 @@ def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
     fwd_perm = [(i, (i + 1) % s) for i in range(s)]
 
     def local(params, xs_l):
-        # params: [1, ...] this stage's slice; xs_l: [ceil(M/S), 1, mb, ...]
-        params = jax.tree.map(lambda p: p[0], params)
+        # params: [v, ...] this device's stage slices;
+        # xs_l: [ceil(M/S), 1, mb, ...]
         xs_l = jax.tree.map(lambda x: x[:, 0], xs_l)
         stage = lax.axis_index(axis)
         zero = jnp.zeros_like(xs_l[0])
@@ -111,7 +127,7 @@ def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
             x_in = lax.psum(
                 jnp.where((stage == t % s) & (t < m), cand, zero), axis)
             x_t = jnp.where(stage == 0, x_in, buf)
-            y = stage_fn(params, x_t)
+            y, _ = _chain_stages(stage_fn, params, x_t)
             # the last stage's result for microbatch (t - (s-1)) is ready
             out_t = jnp.where(stage == s - 1, y, jnp.zeros_like(y))
             y_next = lax.ppermute(y, axis, fwd_perm)
@@ -163,14 +179,13 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
 
     def fn(stacked_params, aux_params, xs, ys):
         s = mesh.shape[axis]
-        _check_stages(stacked_params, s, axis)
+        v = _check_stages(stacked_params, s, axis)
         xs_str, m = _strided(xs, s)
         ys_str, _ = _strided(ys, s)
         total = m + s - 1
         fwd_perm = [(i, (i + 1) % s) for i in range(s)]
 
         def local(params, aux, xs_l, ys_l):
-            params = jax.tree.map(lambda p: p[0], params)
             xs_l = xs_l[:, 0]
             ys_l = ys_l[:, 0]
             stage = lax.axis_index(axis)
@@ -182,14 +197,12 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                 x_in = lax.psum(
                     jnp.where((stage == t % s) & (t < m), cand, zero), axis)
                 x_t = jnp.where(stage == 0, x_in, buf)
-                out = stage_fn(params, x_t)
-                y, stage_aux = out if isinstance(out, tuple) else (out, None)
-                if stage_aux is not None:
-                    # stage s holds a real microbatch at tick t iff
-                    # s <= t < s + m (bubble ticks carry junk)
-                    valid = (stage <= t) & (t < stage + m)
-                    sacc = sacc + jnp.where(
-                        valid, stage_aux.astype(jnp.float32), 0.0)
+                # this device's v virtual stages, chained; their summed
+                # stage-aux counts only while a real microbatch is here
+                # (device s holds one at tick t iff s <= t < s + m)
+                y, stage_aux = _chain_stages(stage_fn, params, x_t)
+                valid = (stage <= t) & (t < stage + m)
+                sacc = sacc + jnp.where(valid, stage_aux, 0.0)
                 # microbatch j finished on the last stage this tick; its
                 # targets stream in from their strided owner the same way
                 j = t - (s - 1)
@@ -207,9 +220,9 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                 tick, (zero, jnp.zeros((), jnp.float32),
                        jnp.zeros((), jnp.float32)), jnp.arange(total))
             loss = lax.psum(acc, axis) / m     # replicate across pp
-            # per-stage aux: mean over the s*m valid (stage, microbatch)
-            # pairs (each stage's sacc holds only its own contributions)
-            loss = loss + lax.psum(sacc, axis) / (s * m)
+            # per-stage aux: mean over the s*v*m valid (global stage,
+            # microbatch) pairs (each device's sacc sums its v stages)
+            loss = loss + lax.psum(sacc, axis) / (s * v * m)
             if baxes:
                 loss = lax.pmean(loss, baxes)  # data-parallel mean
             return loss

@@ -176,15 +176,53 @@ def test_pipelined_lm_parity_vs_single_device(mesh):
                                              rel=2e-4, abs=2e-4)
 
 
-def test_pipeline_rejects_stage_mesh_mismatch():
-    """A stage stack that doesn't match the pp axis 1:1 fails loudly
-    instead of silently running only the first stages."""
+def test_pipeline_virtual_stages_deeper_than_axis(mesh):
+    """A model DEEPER than the pp axis pipelines via virtual stages
+    (v = S_total/S_mesh consecutive stages chained per device per tick):
+    8 stages on pp=4 must match the dense forward exactly."""
+    from paddle_tpu.ops import functional as F
+    model, batch = _lm_and_batch(seed=4, stages=2 * S)   # v = 2
+    tr = _lm_trainer(model, mesh)
+    ts = tr.init_state(jnp.asarray(batch[0]))
+    params0 = jax.device_get(ts.params)
+    _, f = tr.train_step(ts, tr.put_batch(batch))
+    logits = model.apply({"params": params0}, jnp.asarray(batch[0]))
+    want = float(jnp.mean(F.softmax_with_cross_entropy(
+        logits.astype(jnp.float32), jnp.asarray(batch[1]))))
+    assert float(f["loss"]) == pytest.approx(want, rel=2e-4, abs=2e-4)
+
+
+def test_pipeline_single_device_runs_all_stages():
+    """On a 1-device mesh every stage is a virtual stage — the pipelined
+    loss must equal the dense forward (the old 1:1 restriction is gone)."""
+    from paddle_tpu.ops import functional as F
     one = make_mesh(devices=jax.devices()[:1])
     model, batch = _lm_and_batch(seed=4)
-    tr = _lm_trainer(model, one)
+    tr = _lm_trainer(model, one, m=2)
     ts = tr.init_state(jnp.asarray(batch[0]))
-    with pytest.raises(ValueError, match="must map 1:1"):
-        tr.train_step(ts, tr.put_batch(batch))
+    params0 = jax.device_get(ts.params)
+    _, f = tr.train_step(ts, tr.put_batch(batch))
+    logits = model.apply({"params": params0}, jnp.asarray(batch[0]))
+    want = float(jnp.mean(F.softmax_with_cross_entropy(
+        logits.astype(jnp.float32), jnp.asarray(batch[1]))))
+    assert float(f["loss"]) == pytest.approx(want, rel=2e-4, abs=2e-4)
+
+
+def test_pipeline_rejects_non_divisible_stage_stack(mesh):
+    """A stage stack that does not divide the pp axis fails loudly — at
+    state creation (pjit sharding divisibility) or, for unsharded params,
+    at the stream's own _check_stages."""
+    from paddle_tpu.parallel.pipeline import pipeline_loss_fn
+    model, batch = _lm_and_batch(seed=4, stages=3)       # 3 % 4 != 0
+    tr = _lm_trainer(model, mesh)
+    with pytest.raises(ValueError, match="divisible"):
+        tr.init_state(jnp.asarray(batch[0]))
+    # the stream-level guard (reached when params arrive unsharded)
+    bad = stack_stage_params([{"w": jnp.zeros((4, 4))}] * 3)
+    loss = pipeline_loss_fn(lambda p, x: x @ p["w"],
+                            lambda a, b: jnp.mean((a - b) ** 2), mesh)
+    with pytest.raises(ValueError, match="must be a multiple"):
+        jax.jit(loss)(bad, jnp.zeros((8, 4)), jnp.zeros((8, 4)))
 
 
 def test_pipelined_lm_checkpoint_roundtrip(mesh, tmp_path):
