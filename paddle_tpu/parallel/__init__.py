@@ -18,6 +18,6 @@ from paddle_tpu.parallel.moe import (
     moe_ffn_local, moe_partition_specs,
 )
 from paddle_tpu.parallel.ring import (
-    ring_attention, ring_flash_attention, ulysses_attention, zigzag_shard,
-    zigzag_unshard,
+    ring_attention, ring_attention_inner, ring_flash_attention,
+    ulysses_attention, zigzag_shard, zigzag_unshard,
 )

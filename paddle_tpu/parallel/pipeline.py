@@ -152,7 +152,8 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
                                          jax.Array],
                     mesh: Mesh, axis: str = "pp",
                     batch_axes: Sequence[str] = (),
-                    param_specs: Optional[Pytree] = None):
+                    param_specs: Optional[Pytree] = None,
+                    seq_axes: Sequence[str] = ()):
     """Build fn(stacked_params, aux_params, xs, ys) -> mean scalar loss.
 
     The full streaming pipeline: inputs arrive via the strided conveyor,
@@ -174,8 +175,14 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
     scalar (e.g. an MoE load-balancing loss) is accumulated over every
     VALID (stage, microbatch) pair — bubble ticks masked out — averaged,
     and ADDED to the consume_fn loss.
+
+    `seq_axes` lists mesh axes the SEQUENCE dim (xs/ys dim 3) is sharded
+    over: the conveyor then streams local sequence shards, the stage_fn
+    is responsible for cross-shard attention (ring over sp), and the
+    loss is pmean'd across the shards.
     """
     baxes = tuple(batch_axes)
+    saxes = tuple(seq_axes)
 
     def fn(stacked_params, aux_params, xs, ys):
         s = mesh.shape[axis]
@@ -223,14 +230,21 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
             # per-stage aux: mean over the s*v*m valid (global stage,
             # microbatch) pairs (each device's sacc sums its v stages)
             loss = loss + lax.psum(sacc, axis) / (s * v * m)
-            if baxes:
-                loss = lax.pmean(loss, baxes)  # data-parallel mean
+            if baxes or saxes:
+                # data-parallel mean; sequence shards contribute their
+                # local-token means, so the sp pmean gives the global one
+                loss = lax.pmean(loss, baxes + saxes)
             return loss
 
+        def data_spec(arr):
+            # trimmed to rank: low-rank targets (e.g. [M', S, mb] scalar
+            # labels) simply have no sequence dim to shard
+            entries = (None, axis, baxes if baxes else None,
+                       saxes if saxes else None)
+            return P(*entries[:arr.ndim])
+
         in_specs = (param_specs if param_specs is not None else P(axis),
-                    P(),
-                    P(None, axis, baxes if baxes else None),
-                    P(None, axis, baxes if baxes else None))
+                    P(), data_spec(xs_str), data_spec(ys_str))
         return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
                              out_specs=P(), check_vma=False)(
                                  stacked_params, aux_params, xs_str, ys_str)
@@ -272,7 +286,9 @@ def _maybe_psum(v, axis: Optional[str]):
 
 
 def _attention(p: Pytree, x: jax.Array, n_heads: int,
-               tp_axis: Optional[str] = None) -> jax.Array:
+               tp_axis: Optional[str] = None,
+               sp_axis: Optional[str] = None, sp_size: int = 1
+               ) -> jax.Array:
     """Pre-LN causal self-attention sub-layer WITH residual (shared by
     lm_block and moe_lm_block — one home for the packing convention).
 
@@ -280,7 +296,14 @@ def _attention(p: Pytree, x: jax.Array, n_heads: int,
     `tp_axis` the weights arrive column-sliced to whole heads (w_qkv on
     its output dim, w_o on its input dim — Megatron column/row
     parallelism) and the sub-layer closes with one psum over tp.
-    Activations are replicated across tp."""
+    Activations are replicated across tp.
+
+    With `sp_axis`, x is the LOCAL [mb, T/sp, D] sequence shard and the
+    attention core runs as ring attention over that axis (K/V blocks
+    rotate via ppermute, online-softmax merge) — long-context sequence
+    parallelism composed inside the pipeline. tp and sp compose (heads
+    and sequence are orthogonal)."""
+    from paddle_tpu.parallel.ring import ring_attention_inner
     b, t, d = x.shape
     hd = d // n_heads
     h = _layernorm(x, p["ln1_s"], p["ln1_b"])
@@ -288,21 +311,26 @@ def _attention(p: Pytree, x: jax.Array, n_heads: int,
     local_heads = qkv.shape[-1] // (3 * hd)
     qkv = qkv.reshape(b, t, local_heads, 3, hd)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
-    mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
-    s = jnp.where(mask[None, None], s, -1e30)
-    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    if sp_axis is not None:
+        o = ring_attention_inner(q, k, v, sp_axis, sp_size, causal=True)
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
+        mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+        s = jnp.where(mask[None, None], s, -1e30)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
     return x + _maybe_psum(o.reshape(b, t, local_heads * hd) @ p["w_o"],
                            tp_axis)
 
 
 def lm_block(p: Pytree, x: jax.Array, n_heads: int,
-             tp_axis: Optional[str] = None) -> jax.Array:
+             tp_axis: Optional[str] = None,
+             sp_axis: Optional[str] = None, sp_size: int = 1) -> jax.Array:
     """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
     [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked
-    params. See `_attention` for the tp packing contract; the FFN splits
-    w1/b1 on the output dim and w2 on the input dim the same way."""
-    x = _attention(p, x, n_heads, tp_axis)
+    params. See `_attention` for the tp packing and sp ring contracts;
+    the FFN splits w1/b1 on the output dim and w2 on the input dim the
+    same way (and is per-token, so sequence shards pass through)."""
+    x = _attention(p, x, n_heads, tp_axis, sp_axis, sp_size)
     h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
     up = jax.nn.relu(h2 @ p["w1"] + p["b1"])    # [mb,T,F/tp]
     return x + _maybe_psum(up @ p["w2"], tp_axis) + p["b2"]
@@ -541,7 +569,8 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
 def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                       num_microbatches: Optional[int] = None,
                       batch_axes: Sequence[str] = ("dp",),
-                      tp_axis: Optional[str] = None):
+                      tp_axis: Optional[str] = None,
+                      sp_axis: Optional[str] = None):
     """MeshTrainer loss_fn training PipelinedLM through the pipeline.
 
     batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
@@ -551,12 +580,17 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
 
     With `tp_axis`, stage weights shard Megatron-style inside each
     pipeline stage (pp×tp×dp 3D parallelism); pair with
-    `pipeline_rules(axis, tp_axis)` so the TrainState matches.
+    `pipeline_rules(axis, tp_axis)` so the TrainState matches. With
+    `sp_axis`, the sequence dim shards over it and stages run ring
+    attention (pp×sp×dp long-context parallelism; composes with tp).
     """
     from paddle_tpu.ops import functional as F
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
     tp = tp_axis if tp_axis is not None and mesh.shape.get(tp_axis, 1) > 1 \
         else None
+    sp = sp_axis if sp_axis is not None and mesh.shape.get(sp_axis, 1) > 1 \
+        else None
+    sp_size = mesh.shape[sp] if sp else 1
 
     def loss_fn(module, variables, batch, rng, training):
         tok_in, tok_out = batch
@@ -573,6 +607,9 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                 raise ValueError(
                     f"tp={nt} must divide n_heads ({module.n_heads}) "
                     f"and d_ff ({module.d_ff})")
+        if sp is not None and t % sp_size:
+            raise ValueError(
+                f"sp={sp_size} must divide sequence length {t}")
 
         h = p["embed"][tok_in] + p["pos"][:t]
         xs = h.reshape((m, b // m) + h.shape[1:])
@@ -585,9 +622,11 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                 logits.astype(jnp.float32), tgt_mb))
 
         stream = pipeline_stream(
-            partial(lm_block, n_heads=module.n_heads, tp_axis=tp),
+            partial(lm_block, n_heads=module.n_heads, tp_axis=tp,
+                    sp_axis=sp, sp_size=sp_size),
             consume, mesh, axis, batch_axes=baxes,
-            param_specs=_stage_specs(axis, tp) if tp else None)
+            param_specs=_stage_specs(axis, tp) if tp else None,
+            seq_axes=(sp,) if sp else ())
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
                       xs, ys)
         return (loss, {}), {}

@@ -45,53 +45,65 @@ def _block_attend(q, k, v, scale, causal, q_block_idx, k_block_idx,
     return m, l, acc
 
 
+def ring_attention_inner(q_l, k_l, v_l, axis: str, sp: int,
+                         scale: Optional[float] = None,
+                         causal: bool = False):
+    """The ring-attention body for callers ALREADY inside a shard_map
+    whose mesh includes `axis` (e.g. a pipeline stage): q_l/k_l/v_l are
+    the local [B, T/sp, H, D] sequence shards; K/V blocks rotate around
+    the ring via ppermute while online-softmax partials merge. Returns
+    the local output shard [B, T/sp, H, D]."""
+    d = q_l.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    my = lax.axis_index(axis)
+    block_len = q_l.shape[1]
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(step, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # the block currently held arrived from (my - step) mod sp
+        k_idx = (my - step) % sp
+        bm, bl, bacc = _block_attend(q_l, k_cur, v_cur, scale, causal,
+                                     my, k_idx, block_len)
+        # online-softmax merge of (m,l,acc) with block partials
+        m_new = jnp.maximum(m, bm)
+        c1 = jnp.exp(m - m_new)
+        c2 = jnp.exp(bm - m_new)
+        l_new = l * c1 + bl * c2
+        # acc layout [B,Tq,H,D]; coefficients are [B,H,Tq,1]
+        def fix(c):
+            return jnp.transpose(c, (0, 2, 1, 3))   # -> [B,Tq,H,1]
+        acc_new = acc * fix(c1).astype(acc.dtype) \
+            + bacc * fix(c2).astype(acc.dtype)
+        k_nxt = lax.ppermute(k_cur, axis, perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    b, tq, h, _ = q_l.shape
+    m0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+    a0 = jnp.zeros_like(q_l, shape=(b, tq, h, d))
+    _, _, m, l, acc = lax.fori_loop(
+        0, sp, body, (k_l, v_l, m0, l0, a0))
+    denom = jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1, 3))
+    return (acc / denom.astype(acc.dtype)).astype(q_l.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
                    scale: Optional[float] = None, causal: bool = False):
     """Full attention over sequence sharded on `axis`.
 
     q/k/v: global [B, T, H, D] arrays (sharded or shardable on T). Returns
     [B, T, H, D] with the same sharding. Must be called under jit (it uses
-    shard_map internally).
+    shard_map internally; `ring_attention_inner` is the body, reusable
+    from other shard_map contexts such as pipeline stages).
     """
-    d = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / (d ** 0.5)
     sp = mesh.shape[axis]
     spec = P(None, axis, None, None)
 
     def local_fn(q_l, k_l, v_l):
-        # q_l/k_l/v_l: [B, T/sp, H, D] local shards
-        my = lax.axis_index(axis)
-        block_len = q_l.shape[1]
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
-
-        def body(step, carry):
-            k_cur, v_cur, m, l, acc = carry
-            # the block currently held arrived from (my - step) mod sp
-            k_idx = (my - step) % sp
-            bm, bl, bacc = _block_attend(q_l, k_cur, v_cur, scale, causal,
-                                         my, k_idx, block_len)
-            # online-softmax merge of (m,l,acc) with block partials
-            m_new = jnp.maximum(m, bm)
-            c1 = jnp.exp(m - m_new)
-            c2 = jnp.exp(bm - m_new)
-            l_new = l * c1 + bl * c2
-            # acc layout [B,Tq,H,D]; coefficients are [B,H,Tq,1]
-            def fix(c):
-                return jnp.transpose(c, (0, 2, 1, 3))   # -> [B,Tq,H,1]
-            acc_new = acc * fix(c1).astype(acc.dtype) \
-                + bacc * fix(c2).astype(acc.dtype)
-            k_nxt = lax.ppermute(k_cur, axis, perm)
-            v_nxt = lax.ppermute(v_cur, axis, perm)
-            return k_nxt, v_nxt, m_new, l_new, acc_new
-
-        b, tq, h, _ = q_l.shape
-        m0 = jnp.full((b, h, tq, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
-        a0 = jnp.zeros_like(q_l, shape=(b, tq, h, d))
-        _, _, m, l, acc = lax.fori_loop(
-            0, sp, body, (k_l, v_l, m0, l0, a0))
-        denom = jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1, 3))
-        return (acc / denom.astype(acc.dtype)).astype(q_l.dtype)
+        return ring_attention_inner(q_l, k_l, v_l, axis, sp,
+                                    scale=scale, causal=causal)
 
     return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
