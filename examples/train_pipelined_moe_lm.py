@@ -53,27 +53,35 @@ def main():
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor parallelism INSIDE each pipeline stage "
                          "(Megatron column/row splits; pp×tp×dp 3D)")
+    ap.add_argument("--sp", type=int, default=1,
+                    help="sequence parallelism INSIDE each stage (ring "
+                         "attention over sequence shards; composes with "
+                         "--tp for pp×tp×sp×dp)")
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--seq", type=int, default=16)
     ap.add_argument("--vocab", type=int, default=64)
     args = ap.parse_args()
 
-    # ---- pipelined LM on pp(×tp)×dp ------------------------------------
+    # ---- pipelined LM on pp(×tp×sp)×dp ---------------------------------
     n = jax.device_count()
-    if n % args.tp:
-        raise SystemExit(f"--tp {args.tp} must divide the device count {n}")
+    if n % (args.tp * args.sp):
+        raise SystemExit(
+            f"--tp {args.tp} × --sp {args.sp} must divide device count {n}")
     if not args.pp:   # adapt to whatever devices exist (1 chip included)
-        args.pp = max(c for c in (1, 2, 4) if n % (c * args.tp) == 0)
-    args.dp = args.dp or n // (args.pp * args.tp)
-    mesh = make_mesh(MeshConfig(pp=args.pp, tp=args.tp, dp=args.dp))
+        args.pp = max(c for c in (1, 2, 4)
+                      if n % (c * args.tp * args.sp) == 0)
+    args.dp = args.dp or n // (args.pp * args.tp * args.sp)
+    mesh = make_mesh(MeshConfig(pp=args.pp, tp=args.tp, sp=args.sp,
+                                dp=args.dp))
     tp_axis = "tp" if args.tp > 1 else None
+    sp_axis = "sp" if args.sp > 1 else None
     lm = PipelinedLM(args.vocab, d_model=64, n_heads=4, d_ff=128,
                      num_stages=args.pp, max_len=args.seq)
     trainer = MeshTrainer(
         lm, Adam(3e-3),
         pipelined_lm_loss(mesh, num_microbatches=2 * args.pp,
-                          tp_axis=tp_axis),
+                          tp_axis=tp_axis, sp_axis=sp_axis),
         mesh, strategy=DistStrategy(batch_axes=("dp",)),
         rules=pipeline_rules(tp_axis=tp_axis))
 
@@ -84,7 +92,8 @@ def main():
     for step in range(args.steps):
         state, fetches = trainer.train_step(state, batch)
         if step % 10 == 0 or step == args.steps - 1:
-            print(f"[lm pp={args.pp}×tp={args.tp}×dp={args.dp}] "
+            print(f"[lm pp={args.pp}×tp={args.tp}×sp={args.sp}"
+                  f"×dp={args.dp}] "
                   f"step {step:3d} "
                   f"loss {float(fetches['loss']):.4f}")
 
