@@ -459,3 +459,21 @@ def test_pipeline_stream_low_rank_targets(mesh):
         "pp", num_microbatches=4)
     loss = jax.jit(loss_fn)(stacked, x, y)
     assert np.isfinite(float(loss))
+
+
+def test_pipeline_apply_virtual_stages(mesh):
+    """pipeline_apply (the output-returning path) also chains v>1
+    virtual stages per device: 8 stacked stages on pp=4 must equal
+    sequential application of all 8."""
+    rs = np.random.RandomState(14)
+    d = 8
+    per_stage = [{"w": jnp.asarray(rs.randn(d, d) * 0.3, jnp.float32),
+                  "b": jnp.asarray(rs.randn(d) * 0.1, jnp.float32)}
+                 for _ in range(2 * S)]
+    stacked = stack_stage_params(per_stage)
+    xs = jnp.asarray(rs.randn(4, 3, d), jnp.float32)
+    out = jax.jit(lambda p, x: pipeline_apply(
+        stage_fn, p, x, mesh, "pp"))(stacked, xs)
+    want = jax.vmap(lambda x: sequential(per_stage, x))(xs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
