@@ -78,6 +78,18 @@ def _chain_stages(stage_fn: Callable, params_v: Pytree, x: jax.Array):
     return y, jnp.sum(auxes)
 
 
+def _microbatch(x: jax.Array, m: int) -> jax.Array:
+    """[B, ...] -> [m, B/m, ...] with INTERLEAVED assignment (row b goes
+    to microbatch b mod m, position b // m): a batch dp-sharded
+    contiguously on dim 0 then maps to a cleanly dp-sharded
+    microbatch-width dim — the naive contiguous split (microbatch
+    b // (B/m)) makes XLA "involuntarily rematerialize"
+    (replicate-then-repartition) the whole batch at the pjit/shard_map
+    boundary. Loss math is permutation-invariant over the batch."""
+    b = x.shape[0]
+    return x.reshape((b // m, m) + x.shape[1:]).swapaxes(0, 1)
+
+
 def _strided(xs: jax.Array, s: int) -> Tuple[jax.Array, int]:
     """[M, ...] -> ([ceil(M/s), s, ...], M): microbatch t at [t//s, t%s].
 
@@ -267,8 +279,8 @@ def pipeline_loss_fn(stage_fn: Callable, loss_of_outputs: Callable,
 
     def fn(stacked_params, x, y):
         mb = num_microbatches or mesh.shape[axis]
-        xs = x.reshape((mb, x.shape[0] // mb) + x.shape[1:])
-        ys = y.reshape((mb, y.shape[0] // mb) + y.shape[1:])
+        xs = _microbatch(x, mb)
+        ys = _microbatch(y, mb)
         return stream(stacked_params, (), xs, ys)
     return fn
 
@@ -542,8 +554,8 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
                 f"({module.num_experts})")
 
         h = p["embed"][tok_in] + p["pos"][:t]
-        xs = h.reshape((m, b // m) + h.shape[1:])
-        ys = tok_out.reshape((m, b // m) + tok_out.shape[1:])
+        xs = _microbatch(h, m)
+        ys = _microbatch(tok_out, m)
 
         def stage(sp, x):
             y, lb = moe_lm_block(sp, x, module.n_heads, ep_axis=ep,
@@ -612,8 +624,8 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                 f"sp={sp_size} must divide sequence length {t}")
 
         h = p["embed"][tok_in] + p["pos"][:t]
-        xs = h.reshape((m, b // m) + h.shape[1:])
-        ys = tok_out.reshape((m, b // m) + tok_out.shape[1:])
+        xs = _microbatch(h, m)
+        ys = _microbatch(tok_out, m)
 
         def consume(aux, y_mb, tgt_mb):
             lnf_s, lnf_b, head = aux
