@@ -430,6 +430,41 @@ class PipelinedLM(Module):
         x, _ = lax.scan(body, x, stages)        # scan over the stage dim
         return _layernorm(x, lnf_s, lnf_b) @ head
 
+    def generate(self, variables, prompt, num_steps: int,
+                 rng: Optional[jax.Array] = None,
+                 temperature: float = 0.0) -> jax.Array:
+        """Autoregressive continuation: [B, T0] prompt -> [B, T0+steps].
+
+        Greedy at temperature 0, else softmax sampling. Each step runs
+        the full dense causal forward (static shapes, jit-able — the
+        simple recompute decode; the Transformer family's KV-cache
+        `decode_step` is the scale path for serving)."""
+        b, t0 = prompt.shape
+        if t0 < 1:
+            raise ValueError("generate needs a non-empty prompt (the "
+                             "first step conditions on its last token)")
+        total = t0 + num_steps
+        if total > self.max_len:
+            raise ValueError(f"prompt {t0} + steps {num_steps} exceeds "
+                             f"max_len {self.max_len}")
+        tokens = jnp.zeros((b, total), jnp.int32)
+        tokens = tokens.at[:, :t0].set(prompt.astype(jnp.int32))
+
+        def body(i, tok):
+            logits = self.apply(variables, tok)[:, i - 1]   # [B, V]
+            if temperature > 0.0:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rng, i),
+                    logits.astype(jnp.float32) / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return jax.lax.dynamic_update_slice_in_dim(
+                tok, nxt[:, None].astype(jnp.int32), i, axis=1)
+
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng")
+        return jax.lax.fori_loop(t0, total, body, tokens)
+
 
 class PipelinedMoELM(PipelinedLM):
     """PipelinedLM with every stage's dense FFN replaced by a top-k MoE
