@@ -299,8 +299,8 @@ def _maybe_psum(v, axis: Optional[str]):
 
 def _attention(p: Pytree, x: jax.Array, n_heads: int,
                tp_axis: Optional[str] = None,
-               sp_axis: Optional[str] = None, sp_size: int = 1
-               ) -> jax.Array:
+               sp_axis: Optional[str] = None, sp_size: int = 1,
+               sp_mode: str = "ring") -> jax.Array:
     """Pre-LN causal self-attention sub-layer WITH residual (shared by
     lm_block and moe_lm_block — one home for the packing convention).
 
@@ -311,38 +311,55 @@ def _attention(p: Pytree, x: jax.Array, n_heads: int,
     Activations are replicated across tp.
 
     With `sp_axis`, x is the LOCAL [mb, T/sp, D] sequence shard and the
-    attention core runs as ring attention over that axis (K/V blocks
-    rotate via ppermute, online-softmax merge) — long-context sequence
-    parallelism composed inside the pipeline. tp and sp compose (heads
-    and sequence are orthogonal)."""
+    attention core runs sequence-parallel over that axis: sp_mode
+    "ring" (K/V blocks rotate via ppermute, online-softmax merge) or
+    "ulysses" (all_to_all regroups sequence↔heads, dense attention over
+    the full sequence on H/sp local heads, reverse all_to_all) —
+    long-context parallelism composed inside the pipeline. tp and sp
+    compose (heads and sequence are orthogonal; ulysses further needs
+    sp | heads-per-tp-shard)."""
     from paddle_tpu.parallel.ring import ring_attention_inner
     b, t, d = x.shape
     hd = d // n_heads
+
+    def dense(q, k, v, t_glob):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
+        mask = jnp.arange(t_glob)[None, :] <= jnp.arange(t_glob)[:, None]
+        s = jnp.where(mask[None, None], s, -1e30)
+        return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+
     h = _layernorm(x, p["ln1_s"], p["ln1_b"])
     qkv = h @ p["w_qkv"]                        # [mb,T,3D/tp] local heads
     local_heads = qkv.shape[-1] // (3 * hd)
     qkv = qkv.reshape(b, t, local_heads, 3, hd)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    if sp_axis is not None:
+    if sp_axis is not None and sp_mode not in ("ring", "ulysses"):
+        raise ValueError(f"sp_mode must be 'ring' or 'ulysses', "
+                         f"got {sp_mode!r}")
+    if sp_axis is not None and sp_mode == "ring":
         o = ring_attention_inner(q, k, v, sp_axis, sp_size, causal=True)
+    elif sp_axis is not None:                   # ulysses
+        def a2a(z, fwd):                        # seq↔heads regroup
+            return lax.all_to_all(z, sp_axis, split_axis=2 if fwd else 1,
+                                  concat_axis=1 if fwd else 2, tiled=True)
+        o = a2a(dense(a2a(q, True), a2a(k, True), a2a(v, True),
+                      t * sp_size), False)
     else:
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (hd ** 0.5)
-        mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
-        s = jnp.where(mask[None, None], s, -1e30)
-        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+        o = dense(q, k, v, t)
     return x + _maybe_psum(o.reshape(b, t, local_heads * hd) @ p["w_o"],
                            tp_axis)
 
 
 def lm_block(p: Pytree, x: jax.Array, n_heads: int,
              tp_axis: Optional[str] = None,
-             sp_axis: Optional[str] = None, sp_size: int = 1) -> jax.Array:
+             sp_axis: Optional[str] = None, sp_size: int = 1,
+             sp_mode: str = "ring") -> jax.Array:
     """One pre-LN causal transformer block (equal-width: [mb, T, D] ->
     [mb, T, D]); `p` is a per-stage slice of PipelinedLM's stacked
     params. See `_attention` for the tp packing and sp ring contracts;
     the FFN splits w1/b1 on the output dim and w2 on the input dim the
     same way (and is per-token, so sequence shards pass through)."""
-    x = _attention(p, x, n_heads, tp_axis, sp_axis, sp_size)
+    x = _attention(p, x, n_heads, tp_axis, sp_axis, sp_size, sp_mode)
     h2 = _layernorm(x, p["ln2_s"], p["ln2_b"])
     up = jax.nn.relu(h2 @ p["w1"] + p["b1"])    # [mb,T,F/tp]
     return x + _maybe_psum(up @ p["w2"], tp_axis) + p["b2"]
@@ -617,7 +634,8 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                       num_microbatches: Optional[int] = None,
                       batch_axes: Sequence[str] = ("dp",),
                       tp_axis: Optional[str] = None,
-                      sp_axis: Optional[str] = None):
+                      sp_axis: Optional[str] = None,
+                      sp_mode: str = "ring"):
     """MeshTrainer loss_fn training PipelinedLM through the pipeline.
 
     batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
@@ -628,8 +646,10 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     With `tp_axis`, stage weights shard Megatron-style inside each
     pipeline stage (pp×tp×dp 3D parallelism); pair with
     `pipeline_rules(axis, tp_axis)` so the TrainState matches. With
-    `sp_axis`, the sequence dim shards over it and stages run ring
-    attention (pp×sp×dp long-context parallelism; composes with tp).
+    `sp_axis`, the sequence dim shards over it and stages run
+    sequence-parallel attention — sp_mode "ring" (K/V rotation) or
+    "ulysses" (all_to_all seq<->heads; needs sp | heads-per-tp-shard) —
+    pp×sp×dp long-context parallelism, composing with tp.
     """
     from paddle_tpu.ops import functional as F
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
@@ -638,6 +658,9 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     sp = sp_axis if sp_axis is not None and mesh.shape.get(sp_axis, 1) > 1 \
         else None
     sp_size = mesh.shape[sp] if sp else 1
+    if sp_mode not in ("ring", "ulysses"):
+        raise ValueError(f"sp_mode must be 'ring' or 'ulysses', "
+                         f"got {sp_mode!r}")
 
     def loss_fn(module, variables, batch, rng, training):
         tok_in, tok_out = batch
@@ -657,6 +680,12 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
         if sp is not None and t % sp_size:
             raise ValueError(
                 f"sp={sp_size} must divide sequence length {t}")
+        if sp is not None and sp_mode == "ulysses":
+            per_tp = module.n_heads // (mesh.shape[tp] if tp else 1)
+            if per_tp % sp_size:
+                raise ValueError(
+                    f"ulysses sp={sp_size} must divide heads per tp "
+                    f"shard ({per_tp})")
 
         h = p["embed"][tok_in] + p["pos"][:t]
         xs = _microbatch(h, m)
@@ -670,7 +699,7 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
 
         stream = pipeline_stream(
             partial(lm_block, n_heads=module.n_heads, tp_axis=tp,
-                    sp_axis=sp, sp_size=sp_size),
+                    sp_axis=sp, sp_size=sp_size, sp_mode=sp_mode),
             consume, mesh, axis, batch_axes=baxes,
             param_specs=_stage_specs(axis, tp) if tp else None,
             seq_axes=(sp,) if sp else ())
