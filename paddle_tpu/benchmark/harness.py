@@ -46,6 +46,20 @@ def device_peak_flops(dtype_bits: int = 16) -> Optional[float]:
     return None
 
 
+def retry_transient(fn: Callable[[], Any], attempts: int = 2) -> Any:
+    """Run fn(); retry on failure. The axon tunnel's remote-compile
+    channel occasionally drops mid-read ("response body closed") — a
+    transient that must not cost a recorded benchmark an entry. Shared by
+    bench.py and the tools/ profilers so the guard can't drift."""
+    last = None
+    for _ in range(attempts):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any transient counts
+            last = e
+    raise last
+
+
 def chain_k(fn: Callable, k: int):
     """Jitted K-iteration chained step for run_timed's caller contract.
 
@@ -205,10 +219,19 @@ def run_timed(step_once: Callable[[Any], Tuple[Any, Any]], state,
 def bench_trainer(name: str, trainer, ts, batch, items_per_step: int,
                   unit: str, batch_size: int, min_time: float = 2.0,
                   baseline: Optional[float] = None,
-                  baseline_is_ms: bool = False) -> BenchResult:
+                  baseline_is_ms: bool = False,
+                  extra_flops: float = 0.0) -> BenchResult:
     """Benchmark one (trainer, state, batch): the common wrapper used by
     every model spec in models.py. `trainer` is core.executor.Trainer or
-    parallel.trainer.MeshTrainer (same train_step contract)."""
+    parallel.trainer.MeshTrainer (same train_step contract).
+
+    extra_flops: analytic correction added to the compiled-executable
+    count for FLOPs XLA's cost analysis structurally misses — it counts a
+    scan/fori_loop body ONCE regardless of trip count (see PERF_NOTES
+    measurement-integrity notes), so steps that loop over matmul chunks
+    (ops/fused_ce.py) pass the known per-iteration matmul FLOPs x the
+    uncounted iterations here. Keep corrections analytic and
+    matmul-only — never estimates of fused elementwise work."""
     rng = jax.random.key(0)
 
     def step_once(state):
@@ -220,6 +243,8 @@ def bench_trainer(name: str, trainer, ts, batch, items_per_step: int,
     jitted = getattr(trainer, "_train_step", None)
     if jitted is not None:
         flops = compiled_flops(jitted, ts, batch, rng)
+        if flops:
+            flops += extra_flops
 
     tflops = (flops / sec_per_step / 1e12) if flops else None
     peak = device_peak_flops()

@@ -91,23 +91,37 @@ def _mnist(name, batch_size, dtype, mesh, strategy, rules, min_time):
 
 def _transformer(name, batch_size, dtype, mesh, strategy, rules, min_time,
                  seq_len: int = 256, vocab: int = 32000,
-                 fused_qkv: bool = False, raw_ce: bool = False):
+                 fused_qkv: bool = False, raw_ce: bool = False,
+                 fused_ce: bool = False):
     """Transformer-base WMT (machine_translation.py / dist_transformer.py):
     tokens/s on the teacher-forced train step.
 
-    fused_qkv / raw_ce are perf-variant knobs (tools/profile_transformer.py
-    A/B sweep): Megatron-packed projections, and feeding bf16 logits
-    straight to the internally-promoting CE instead of materializing an
-    f32 [B,T,V] copy first."""
+    fused_qkv / raw_ce / fused_ce are perf-variant knobs
+    (tools/profile_transformer.py A/B sweep): Megatron-packed projections;
+    feeding bf16 logits straight to the internally-promoting CE instead of
+    materializing an f32 [B,T,V] copy first; and the chunked
+    linear_cross_entropy that never materializes [B,T,V] at all
+    (ops/fused_ce.py)."""
     from paddle_tpu.models.transformer import Transformer
     bs = batch_size or 32
-    model = Transformer(src_vocab=vocab, trg_vocab=vocab, model_dim=512,
+    dim = 512
+    model = Transformer(src_vocab=vocab, trg_vocab=vocab, model_dim=dim,
                         num_heads=8, num_layers=6, ffn_dim=2048,
                         dropout=0.0, max_len=seq_len + 1, dtype=dtype,
                         fused_qkv=fused_qkv)
 
     def loss_fn(module, variables, batch, rng, training):
         src, trg_in, trg_out = batch
+        if fused_ce:
+            from paddle_tpu.ops.fused_ce import linear_cross_entropy
+            hid, mut = module.apply(variables, src, trg_in,
+                                    training=training, rngs=rng,
+                                    mutable=True, return_hidden=True)
+            head = variables["params"]["head"]
+            loss = jnp.mean(linear_cross_entropy(
+                hid, head["weight"].astype(hid.dtype), trg_out,
+                head["bias"].astype(hid.dtype)))
+            return (loss, {}), mut.get("state", {})
         logits, mut = module.apply(variables, src, trg_in, training=training,
                                    rngs=rng, mutable=True)
         if not raw_ce:
@@ -122,8 +136,20 @@ def _transformer(name, batch_size, dtype, mesh, strategy, rules, min_time,
     ts = trainer.init_state(jnp.asarray(src), jnp.asarray(trg[:, :-1]))
     batch = _put(trainer, (src, trg[:, :-1], trg[:, 1:]))
     tokens = bs * seq_len
+    extra_flops = 0.0
+    if fused_ce:
+        # Put the fused variant's MFU on the same model-FLOPs basis as
+        # the unfused one (remat convention: recompute is not useful
+        # work). Unfused head path = 6*N*D*V (fwd logits + two bwd
+        # matmuls). XLA's cost analysis counts each fused-CE scan body
+        # exactly once: fwd 2*N*D*chunk + bwd 6*N*D*chunk (recompute,
+        # dl@wc^T, h^T@dl) = 8*N*D*chunk already counted.
+        from paddle_tpu.ops.fused_ce import effective_chunk
+        chunk = effective_chunk(vocab)
+        extra_flops = float(tokens) * dim * (6.0 * vocab - 8.0 * chunk)
     return bench_trainer(name, trainer, ts, batch, items_per_step=tokens,
-                         unit="tokens/s", batch_size=bs, min_time=min_time)
+                         unit="tokens/s", batch_size=bs, min_time=min_time,
+                         extra_flops=extra_flops)
 
 
 def _stacked_lstm(name, batch_size, dtype, mesh, strategy, rules, min_time,

@@ -225,7 +225,8 @@ class Transformer(Module):
         return self.enc_ln(cx, x), mask
 
     # -- decoder (teacher-forced training path) ---------------------------
-    def decode_train(self, cx: Context, trg_tokens, memory, src_mask=None):
+    def decode_train(self, cx: Context, trg_tokens, memory, src_mask=None,
+                     return_hidden: bool = False):
         t = trg_tokens.shape[1]
         x = self.trg_embed(cx, trg_tokens) * math.sqrt(self.model_dim)
         x = x + sinusoid_position_encoding(t, self.model_dim).astype(x.dtype)
@@ -233,11 +234,20 @@ class Transformer(Module):
         for layer in self.dec_layers:
             x, _ = layer(cx, x, memory, self_causal=True,
                          cross_mask=src_mask)
-        return self.head(cx, self.dec_ln(cx, x))
+        x = self.dec_ln(cx, x)
+        if return_hidden:
+            # pre-head hidden states, for losses that fuse the vocab
+            # projection (ops.fused_ce.linear_cross_entropy). Touch the
+            # head params so init traces them even on this path.
+            self.head(cx, x[:1, :1])
+            return x
+        return self.head(cx, x)
 
-    def forward(self, cx: Context, src_tokens, trg_tokens, src_lengths=None):
+    def forward(self, cx: Context, src_tokens, trg_tokens, src_lengths=None,
+                return_hidden: bool = False):
         memory, src_mask = self.encode(cx, src_tokens, src_lengths)
-        return self.decode_train(cx, trg_tokens, memory, src_mask)
+        return self.decode_train(cx, trg_tokens, memory, src_mask,
+                                 return_hidden=return_hidden)
 
     # -- incremental decode (for beam search) ------------------------------
     def init_cache(self, batch: int, max_len: Optional[int] = None):

@@ -297,6 +297,11 @@ def _maybe_psum(v, axis: Optional[str]):
     return lax.psum(v, axis) if axis is not None else v
 
 
+# sequence-parallel attention modes supported inside pipeline stages;
+# the single source of truth for validation here and in pipelined_lm_loss
+SP_MODES = ("ring", "ulysses")
+
+
 def _attention(p: Pytree, x: jax.Array, n_heads: int,
                tp_axis: Optional[str] = None,
                sp_axis: Optional[str] = None, sp_size: int = 1,
@@ -333,8 +338,8 @@ def _attention(p: Pytree, x: jax.Array, n_heads: int,
     local_heads = qkv.shape[-1] // (3 * hd)
     qkv = qkv.reshape(b, t, local_heads, 3, hd)
     q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-    if sp_axis is not None and sp_mode not in ("ring", "ulysses"):
-        raise ValueError(f"sp_mode must be 'ring' or 'ulysses', "
+    if sp_axis is not None and sp_mode not in SP_MODES:
+        raise ValueError(f"sp_mode must be one of {SP_MODES}, "
                          f"got {sp_mode!r}")
     if sp_axis is not None and sp_mode == "ring":
         o = ring_attention_inner(q, k, v, sp_axis, sp_size, causal=True)
@@ -658,8 +663,8 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     sp = sp_axis if sp_axis is not None and mesh.shape.get(sp_axis, 1) > 1 \
         else None
     sp_size = mesh.shape[sp] if sp else 1
-    if sp_mode not in ("ring", "ulysses"):
-        raise ValueError(f"sp_mode must be 'ring' or 'ulysses', "
+    if sp_mode not in SP_MODES:
+        raise ValueError(f"sp_mode must be one of {SP_MODES}, "
                          f"got {sp_mode!r}")
 
     def loss_fn(module, variables, batch, rng, training):

@@ -1,0 +1,127 @@
+"""Parity tests for ops/fused_ce.py linear_cross_entropy: the chunked
+online-softmax CE must match matmul + softmax_with_cross_entropy
+(ops/functional.py) in value and in gradients wrt activations, weights,
+and bias — including ignore_index rows and a vocab that does not divide
+the chunk width."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import functional as F
+from paddle_tpu.ops.fused_ce import linear_cross_entropy
+
+
+def _ref_loss(h, w, labels, b, ignore_index=-100):
+    logits = h @ w + b
+    return F.softmax_with_cross_entropy(logits.astype(jnp.float32),
+                                        labels, ignore_index=ignore_index)
+
+
+@pytest.mark.parametrize("v,chunk", [(64, 256), (1000, 256), (512, 128)])
+def test_forward_matches_unfused(v, chunk):
+    rs = np.random.RandomState(0)
+    n, d = 33, 24
+    h = jnp.asarray(rs.randn(n, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    b = jnp.asarray(rs.randn(v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, n), jnp.int32)
+    got = linear_cross_entropy(h, w, labels, b, chunk=chunk)
+    np.testing.assert_allclose(got, _ref_loss(h, w, labels, b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ignore_index_rows_zero_loss_and_grad():
+    rs = np.random.RandomState(1)
+    n, d, v = 16, 8, 300
+    h = jnp.asarray(rs.randn(n, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    labels = np.asarray(rs.randint(0, v, n), np.int32)
+    labels[::3] = -100
+    labels = jnp.asarray(labels)
+
+    loss = linear_cross_entropy(h, w, labels, chunk=128)
+    assert np.all(np.asarray(loss)[::3] == 0.0)
+
+    dh = jax.grad(lambda hh: jnp.sum(
+        linear_cross_entropy(hh, w, labels, chunk=128)))(h)
+    assert np.all(np.asarray(dh)[::3] == 0.0)
+    assert np.any(np.asarray(dh)[1] != 0.0)
+
+
+def test_gradients_match_unfused():
+    rs = np.random.RandomState(2)
+    n, d, v = 20, 12, 700   # 700 pads to 768 at chunk=256
+    h = jnp.asarray(rs.randn(n, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    b = jnp.asarray(rs.randn(v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, n), jnp.int32)
+    # non-uniform upstream cotangent: weight each row's loss differently
+    gw = jnp.asarray(rs.rand(n), jnp.float32)
+
+    def fused(h, w, b):
+        return jnp.sum(gw * linear_cross_entropy(h, w, labels, b,
+                                                 chunk=256))
+
+    def ref(h, w, b):
+        return jnp.sum(gw * _ref_loss(h, w, labels, b))
+
+    got = jax.grad(fused, argnums=(0, 1, 2))(h, w, b)
+    want = jax.grad(ref, argnums=(0, 1, 2))(h, w, b)
+    for g, wnt, name in zip(got, want, "h w b".split()):
+        np.testing.assert_allclose(g, wnt, rtol=2e-4, atol=2e-5,
+                                   err_msg=f"grad wrt {name}")
+
+
+def test_leading_dims_and_no_bias():
+    rs = np.random.RandomState(3)
+    bsz, t, d, v = 3, 5, 8, 120
+    h = jnp.asarray(rs.randn(bsz, t, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, v, (bsz, t)), jnp.int32)
+    got = linear_cross_entropy(h, w, labels, chunk=64)
+    assert got.shape == (bsz, t)
+    want = _ref_loss(h.reshape(-1, d), w, labels.reshape(-1),
+                     jnp.zeros(v)).reshape(bsz, t)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_close_to_f32_reference():
+    rs = np.random.RandomState(4)
+    n, d, v = 64, 32, 520
+    hf = rs.randn(n, d).astype(np.float32)
+    wf = (rs.randn(d, v) * 0.1).astype(np.float32)
+    labels = jnp.asarray(rs.randint(0, v, n), jnp.int32)
+    got = linear_cross_entropy(jnp.asarray(hf, jnp.bfloat16),
+                               jnp.asarray(wf, jnp.bfloat16),
+                               labels, chunk=256)
+    want = _ref_loss(jnp.asarray(hf), jnp.asarray(wf), labels,
+                     jnp.zeros(v))
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_transformer_fused_ce_path_matches_head_logits():
+    """Model-level: return_hidden + linear_cross_entropy == full logits
+    + softmax_with_cross_entropy on the same variables."""
+    from paddle_tpu.models.transformer import Transformer
+
+    rs = np.random.RandomState(5)
+    v, bsz, t = 97, 2, 6
+    model = Transformer(src_vocab=v, trg_vocab=v, model_dim=16,
+                        num_heads=2, num_layers=1, ffn_dim=32,
+                        dropout=0.0, max_len=t + 1)
+    src = jnp.asarray(rs.randint(0, v, (bsz, t)), jnp.int32)
+    trg = jnp.asarray(rs.randint(0, v, (bsz, t)), jnp.int32)
+    out = jnp.asarray(rs.randint(0, v, (bsz, t)), jnp.int32)
+    variables = model.init(jax.random.key(0), src, trg)
+
+    logits = model.apply(variables, src, trg)
+    want = F.softmax_with_cross_entropy(logits.astype(jnp.float32), out)
+
+    hid = model.apply(variables, src, trg, return_hidden=True)
+    head = variables["params"]["head"]
+    got = linear_cross_entropy(hid, head["weight"], out, head["bias"],
+                               chunk=64)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)

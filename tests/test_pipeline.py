@@ -552,3 +552,52 @@ def test_pipelined_lm_sp_ulysses():
     _, df = dense.train_step(dts, (batch[0], batch[1]))
     assert float(f["loss"]) == pytest.approx(float(df["loss"]),
                                              rel=2e-4, abs=2e-4)
+
+
+def test_pipelined_lm_ulysses_composes_with_tp():
+    """Ulysses × tensor parallelism: pp=2 × tp=2 × sp=2 with 4 heads
+    (2 per tp shard, sp=2 divides them — the all_to_all regroups LOCAL
+    heads). Loss parity vs the dense trainer, same bar as the ring 4D
+    test; plus the divisibility guard when heads-per-tp-shard < sp."""
+    from paddle_tpu.core.executor import Trainer, supervised_loss
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import DistStrategy, MeshTrainer
+    from paddle_tpu.parallel.mesh import MeshConfig
+
+    mesh = make_mesh(MeshConfig(pp=2, tp=2, sp=2))
+    vocab, b, t = 32, 16, 8
+    model = PipelinedLM(vocab, d_model=16, n_heads=4, d_ff=32,
+                        num_stages=2, max_len=t)
+    rs = np.random.RandomState(17)
+    tok = rs.randint(0, vocab, (b, t + 1)).astype(np.int32)
+    batch = (tok[:, :-1], tok[:, 1:])
+    tr = MeshTrainer(
+        model, Adam(1e-2),
+        pipelined_lm_loss(mesh, num_microbatches=4, tp_axis="tp",
+                          sp_axis="sp", sp_mode="ulysses"),
+        mesh, strategy=DistStrategy(batch_axes=("dp",)),
+        rules=pipeline_rules(tp_axis="tp"))
+    ts = tr.init_state(jnp.asarray(batch[0]))
+    ts, f = tr.train_step(ts, tr.put_batch(batch))
+
+    dense = Trainer(model, Adam(1e-2), supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(
+            lg.astype(jnp.float32), y)))
+    dts = dense.init_state(jnp.asarray(batch[0]))
+    _, df = dense.train_step(dts, (batch[0], batch[1]))
+    assert float(f["loss"]) == pytest.approx(float(df["loss"]),
+                                             rel=2e-4, abs=2e-4)
+
+    # 2 heads / tp=2 -> 1 local head; sp=2 cannot split it
+    small = PipelinedLM(vocab, d_model=16, n_heads=2, d_ff=32,
+                        num_stages=2, max_len=t)
+    bad = MeshTrainer(
+        small, Adam(1e-2),
+        pipelined_lm_loss(mesh, num_microbatches=4, tp_axis="tp",
+                          sp_axis="sp", sp_mode="ulysses"),
+        mesh, strategy=DistStrategy(batch_axes=("dp",)),
+        rules=pipeline_rules(tp_axis="tp"))
+    bts = bad.init_state(jnp.asarray(batch[0]))
+    with pytest.raises(ValueError, match="divide heads per tp"):
+        bad.train_step(bts, bad.put_batch(batch))

@@ -12,7 +12,6 @@ Usage: python tools/profile_transformer.py [--bs 64] [--seq 256]
 """
 
 import argparse
-import itertools
 import sys
 
 import _bootstrap  # noqa: F401  (repo path + JAX cpu-override workaround)
@@ -38,31 +37,51 @@ def main():
     if not on_tpu:
         print("WARNING: not on TPU — numbers are CPU smoke only")
 
+    # raw_ce and fused_ce address the same logits path (fused_ce subsumes
+    # raw_ce), so sweep fused_qkv x {plain, raw_ce, fused_ce}
+    variants = [(f, r, c) for f in (False, True)
+                for r, c in ((False, False), (True, False), (False, True))]
+    from paddle_tpu.benchmark.harness import retry_transient as _retry
+
     results = {}
-    for fused, raw in itertools.product((False, True), repeat=2):
+    for fused, raw, fce in variants:
         label = "+".join(n for n, on in (("fused_qkv", fused),
-                                         ("raw_ce", raw)) if on) or "baseline"
-        r = run_model("transformer", batch_size=args.bs, dtype=dtype,
-                      min_time=args.min_time, seq_len=args.seq,
-                      fused_qkv=fused, raw_ce=raw)
+                                         ("raw_ce", raw),
+                                         ("fused_ce", fce)) if on) or "baseline"
+        try:
+            r = _retry(lambda: run_model(
+                "transformer", batch_size=args.bs, dtype=dtype,
+                min_time=args.min_time, seq_len=args.seq,
+                fused_qkv=fused, raw_ce=raw, fused_ce=fce))
+        except Exception as e:  # a dead variant shouldn't kill the sweep
+            print(f"{label:24s} FAILED: {type(e).__name__}: {e}")
+            continue
         results[label] = r
         print(f"{label:24s} {r.value:12.0f} tok/s  "
               f"mfu={r.mfu:.4f}  {r.ms_per_step:7.2f} ms"
               if r.mfu else f"{label:24s} {r.value:12.0f} tok/s")
 
+    if not results:
+        print("\nall variants failed")
+        return 1
     best = max(results, key=lambda k: results[k].value)
-    base = results["baseline"]
-    print(f"\nbest: {best}  (+{(results[best].value / base.value - 1) * 100:.1f}%"
-          f" vs baseline)")
+    base = results.get("baseline")
+    rel = (f"  (+{(results[best].value / base.value - 1) * 100:.1f}%"
+           f" vs baseline)") if base else ""
+    print(f"\nbest: {best}{rel}")
+
+    def _knobs(label):
+        return dict(fused_qkv="fused_qkv" in label,
+                    raw_ce="raw_ce" in label,
+                    fused_ce="fused_ce" in label)
 
     if args.sweep_bs:
-        fused = "fused_qkv" in best
-        raw = "raw_ce" in best
         for bs in (32, 64, 96, 128):
             try:
-                r = run_model("transformer", batch_size=bs, dtype=dtype,
-                              min_time=args.min_time, seq_len=args.seq,
-                              fused_qkv=fused, raw_ce=raw)
+                r = _retry(lambda: run_model(
+                    "transformer", batch_size=bs, dtype=dtype,
+                    min_time=args.min_time, seq_len=args.seq,
+                    **_knobs(best)))
                 print(f"bs={bs:4d}  {r.value:12.0f} tok/s  "
                       f"mfu={r.mfu:.4f}" if r.mfu
                       else f"bs={bs:4d}  {r.value:12.0f} tok/s")
@@ -74,13 +93,13 @@ def main():
 
         from paddle_tpu.profiler.device_trace import op_table
         for label in dict.fromkeys(("baseline", best)):
-            fused = "fused_qkv" in label
-            raw = "raw_ce" in label
+            if label not in results:
+                continue
             d = tempfile.mkdtemp(prefix=f"xf_{label.replace('+', '_')}_")
             with jax.profiler.trace(d):
-                run_model("transformer", batch_size=args.bs, dtype=dtype,
-                          min_time=1.0, seq_len=args.seq,
-                          fused_qkv=fused, raw_ce=raw)
+                _retry(lambda: run_model(
+                    "transformer", batch_size=args.bs, dtype=dtype,
+                    min_time=1.0, seq_len=args.seq, **_knobs(label)))
             print(f"\n=== op table: {label} ===")
             try:
                 print(op_table(d, by="category", steps=3))
