@@ -297,6 +297,25 @@ def _maybe_psum(v, axis: Optional[str]):
     return lax.psum(v, axis) if axis is not None else v
 
 
+def _lm_consume(fused_ce: bool):
+    """Last-stage loss sink shared by pipelined_lm_loss and
+    pipelined_moe_lm_loss: final layernorm + vocab head + mean CE over
+    the microbatch. fused_ce swaps in ops.fused_ce.linear_cross_entropy
+    (chunked online-softmax — the [tokens, V] logits never materialize),
+    same loss to numerical noise (parity-tested both paths)."""
+    def consume(aux, y_mb, tgt_mb):
+        lnf_s, lnf_b, head = aux
+        h = _layernorm(y_mb, lnf_s, lnf_b)
+        if fused_ce:
+            from paddle_tpu.ops.fused_ce import linear_cross_entropy
+            return jnp.mean(linear_cross_entropy(h, head, tgt_mb))
+        from paddle_tpu.ops import functional as F
+        logits = h @ head
+        return jnp.mean(F.softmax_with_cross_entropy(
+            logits.astype(jnp.float32), tgt_mb))
+    return consume
+
+
 # sequence-parallel attention modes supported inside pipeline stages;
 # the single source of truth for validation here and in pipelined_lm_loss
 SP_MODES = ("ring", "ulysses")
@@ -585,13 +604,15 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
                           num_microbatches: Optional[int] = None,
                           batch_axes: Sequence[str] = ("dp",),
                           ep_axis: Optional[str] = "ep",
-                          lb_weight: float = 0.01):
+                          lb_weight: float = 0.01,
+                          fused_ce: bool = False):
     """MeshTrainer loss_fn training PipelinedMoELM: CE streamed on the
     last stage + lb_weight × the Switch load-balance aux averaged over
     every (stage, microbatch). Expert stacks shard over `ep_axis`
     (pp×ep×dp); pair with `pipeline_moe_rules(axis, ep_axis)`.
+    `fused_ce` as in pipelined_lm_loss (chunked linear+CE, no [N, V]
+    logits materialization).
     """
-    from paddle_tpu.ops import functional as F
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
     ep = ep_axis if ep_axis is not None and mesh.shape.get(ep_axis, 1) > 1 \
         else None
@@ -620,14 +641,8 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
                                  capacity_factor=module.capacity_factor)
             return y, lb_weight * lb
 
-        def consume(aux, y_mb, tgt_mb):
-            lnf_s, lnf_b, head = aux
-            logits = _layernorm(y_mb, lnf_s, lnf_b) @ head
-            return jnp.mean(F.softmax_with_cross_entropy(
-                logits.astype(jnp.float32), tgt_mb))
-
         stream = pipeline_stream(
-            stage, consume, mesh, axis, batch_axes=baxes,
+            stage, _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
             param_specs=_moe_stage_specs(axis, ep))
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
                       xs, ys)
@@ -640,7 +655,8 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                       batch_axes: Sequence[str] = ("dp",),
                       tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None,
-                      sp_mode: str = "ring"):
+                      sp_mode: str = "ring",
+                      fused_ce: bool = False):
     """MeshTrainer loss_fn training PipelinedLM through the pipeline.
 
     batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
@@ -655,8 +671,13 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     sequence-parallel attention — sp_mode "ring" (K/V rotation) or
     "ulysses" (all_to_all seq<->heads; needs sp | heads-per-tp-shard) —
     pp×sp×dp long-context parallelism, composing with tp.
+
+    `fused_ce` computes the loss via ops.fused_ce.linear_cross_entropy:
+    the [mb_tokens, V] logits are never materialized (online softmax
+    over vocab chunks), shrinking the last stage's peak activation from
+    O(tokens·V) to O(tokens·chunk) — the knob for long sequences or
+    large vocabularies; exact same loss (parity-tested).
     """
-    from paddle_tpu.ops import functional as F
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
     tp = tp_axis if tp_axis is not None and mesh.shape.get(tp_axis, 1) > 1 \
         else None
@@ -696,16 +717,10 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
         xs = _microbatch(h, m)
         ys = _microbatch(tok_out, m)
 
-        def consume(aux, y_mb, tgt_mb):
-            lnf_s, lnf_b, head = aux
-            logits = _layernorm(y_mb, lnf_s, lnf_b) @ head
-            return jnp.mean(F.softmax_with_cross_entropy(
-                logits.astype(jnp.float32), tgt_mb))
-
         stream = pipeline_stream(
             partial(lm_block, n_heads=module.n_heads, tp_axis=tp,
                     sp_axis=sp, sp_size=sp_size, sp_mode=sp_mode),
-            consume, mesh, axis, batch_axes=baxes,
+            _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
             param_specs=_stage_specs(axis, tp) if tp else None,
             seq_axes=(sp,) if sp else ())
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),

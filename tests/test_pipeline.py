@@ -601,3 +601,53 @@ def test_pipelined_lm_ulysses_composes_with_tp():
     bts = bad.init_state(jnp.asarray(batch[0]))
     with pytest.raises(ValueError, match="divide heads per tp"):
         bad.train_step(bts, bad.put_batch(batch))
+
+
+def test_pipelined_lm_fused_ce_matches_plain(mesh):
+    """fused_ce=True (chunked linear+CE, no [N,V] logits) must produce
+    the same pipelined loss as the plain head@CE path on pp×dp."""
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import DistStrategy, MeshTrainer
+
+    model, batch = _lm_and_batch(seed=18)
+    losses = {}
+    for fused in (False, True):
+        tr = MeshTrainer(
+            model, Adam(1e-2),
+            pipelined_lm_loss(mesh, num_microbatches=4, fused_ce=fused),
+            mesh, strategy=DistStrategy(batch_axes=("dp",)),
+            rules=pipeline_rules())
+        ts = tr.init_state(jnp.asarray(batch[0]))
+        _, f = tr.train_step(ts, tr.put_batch(batch))
+        losses[fused] = float(f["loss"])
+    assert losses[True] == pytest.approx(losses[False], rel=1e-5, abs=1e-5)
+
+
+def test_pipelined_moe_lm_fused_ce_matches_plain():
+    """Same parity bar for the MoE pipeline's streamed CE."""
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.parallel.pipeline import (PipelinedMoELM,
+                                              pipeline_moe_rules,
+                                              pipelined_moe_lm_loss)
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import DistStrategy, MeshTrainer
+
+    mesh = make_mesh(MeshConfig(pp=2, ep=2, dp=2))
+    vocab, b, t = 32, 16, 8
+    model = PipelinedMoELM(vocab, d_model=16, n_heads=2, d_ff=32,
+                           num_stages=2, num_experts=4, max_len=t)
+    rs = np.random.RandomState(19)
+    tok = rs.randint(0, vocab, (b, t + 1)).astype(np.int32)
+    batch = (tok[:, :-1], tok[:, 1:])
+    losses = {}
+    for fused in (False, True):
+        tr = MeshTrainer(
+            model, Adam(1e-2),
+            pipelined_moe_lm_loss(mesh, num_microbatches=4,
+                                  fused_ce=fused),
+            mesh, strategy=DistStrategy(batch_axes=("dp",)),
+            rules=pipeline_moe_rules())
+        ts = tr.init_state(jnp.asarray(batch[0]))
+        _, f = tr.train_step(ts, tr.put_batch(batch))
+        losses[fused] = float(f["loss"])
+    assert losses[True] == pytest.approx(losses[False], rel=1e-5, abs=1e-5)
