@@ -426,6 +426,18 @@ def main():
         except Exception as e:
             extra["longcontext_error"] = f"{type(e).__name__}: {e}"[:160]
 
+    if _gate("lm16k", est_s=180):  # 16k-token causal-LM TRAIN step:
+        # flash causal attention + fused CE (no [T,V] logits) — the
+        # long-context training headline (SURVEY §5.7)
+        try:
+            lm = _retry(lambda: run_model("lm_longctx", batch_size=1,
+                                          dtype=dtype, min_time=min_time))
+            extra["lm16k_tokens_per_sec"] = round(lm.value, 1)
+            extra["lm16k_mfu"] = round(lm.mfu, 4) if lm.mfu else None
+            extra["lm16k_ms_per_step"] = round(lm.ms_per_step, 2)
+        except Exception as e:
+            extra["lm16k_error"] = f"{type(e).__name__}: {e}"[:160]
+
     if _gate("moe"):  # MoE dispatch: masked (E×) vs all_to_all (k·cf×)
         try:
             extra.update(_retry(lambda: _moe_bench(min_time=min_time)))

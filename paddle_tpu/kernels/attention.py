@@ -59,6 +59,27 @@ def _on_tpu() -> bool:
         return False
 
 
+def would_use_flash(q_shape, k_shape, has_mask: bool = False,
+                    dropout_rate: float = 0.0) -> bool:
+    """mha's flash-dispatch gate, exported so callers that must AGREE
+    with the dispatch (the analytic MFU corrections in
+    benchmark/models.py — the flash custom call scores 0 flops in XLA's
+    cost analysis) evaluate the same predicate, not a copy.
+
+    The kernel pads ragged sequence lengths to block multiples itself,
+    so the gate only excludes: shapes where XLA's dense attention is
+    simply faster, head dims the MXU tiles badly, dropout, and arbitrary
+    dense masks. Measured on v5e (fwd+bwd, bf16, causal): XLA wins 3.6x
+    at T=256; flash wins 1.9x at T=1024 and is the only feasible path at
+    16k+ (the [B,H,Tq,Tk] score tensor stops fitting) — so the gate is
+    the kv length crossing 512."""
+    return (FLAGS.get("flash_attention") and _on_tpu()
+            and not has_mask
+            and dropout_rate == 0.0
+            and q_shape[1] >= 64 and k_shape[1] >= 512
+            and q_shape[-1] % 32 == 0 and q_shape[-1] <= 256)
+
+
 def mha(q, k, v, mask=None, scale: Optional[float] = None,
         dropout_rng=None, dropout_rate: float = 0.0, causal: bool = False,
         kv_len: Optional[int] = None):
@@ -69,19 +90,8 @@ def mha(q, k, v, mask=None, scale: Optional[float] = None,
     dense `mask` would force the XLA reference path. An explicit `mask`
     (arbitrary pattern) always uses the reference path.
     """
-    # The kernel pads ragged sequence lengths to block multiples itself, so
-    # the gate only excludes: shapes where XLA's dense attention is simply
-    # faster, head dims the MXU tiles badly, dropout, and arbitrary dense
-    # masks. Measured on v5e (fwd+bwd, bf16, causal): XLA wins 3.6x at
-    # T=256; flash wins 1.9x at T=1024 and is the only feasible path at
-    # 16k+ (the [B,H,Tq,Tk] score tensor stops fitting) — so the gate is
-    # the kv length crossing 512.
-    use_flash = (FLAGS.get("flash_attention") and _on_tpu()
-                 and mask is None
-                 and dropout_rate == 0.0
-                 and q.shape[1] >= 64 and k.shape[1] >= 512
-                 and q.shape[-1] % 32 == 0 and q.shape[-1] <= 256)
-    if use_flash:
+    if would_use_flash(q.shape, k.shape, has_mask=mask is not None,
+                       dropout_rate=dropout_rate):
         from paddle_tpu.kernels import flash
         return flash.flash_attention(q, k, v, scale=scale, causal=causal,
                                      kv_len=kv_len)

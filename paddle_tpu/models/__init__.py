@@ -2,7 +2,8 @@ from paddle_tpu.models.vision import (
     AlexNet, GoogLeNet, LeNet, MLP, ResNet, SEResNeXt, VGG, resnet50,
     se_resnext50, vgg16,
 )
-from paddle_tpu.models.transformer import Transformer
+from paddle_tpu.models.transformer import (BertEncoder, CausalLM,
+    Transformer)
 from paddle_tpu.models.nlp import (
     DeepFM, Recommender, Seq2Seq, TextClassifier, Word2Vec,
 )

@@ -27,7 +27,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.module import Context, Module
+from paddle_tpu.core.module import Context, Module, PARAMS
 from paddle_tpu.nn import initializers as I
 from paddle_tpu.nn.layers import Dropout, Embedding, LayerNorm, Linear
 from paddle_tpu.ops import functional as F
@@ -278,6 +278,167 @@ class Transformer(Module):
             new_caches.append(nc)
         logits = self.head(cx, self.dec_ln(cx, x))
         return logits[:, 0], new_caches
+
+
+class CausalBlock(Module):
+    """Pre-LN causal self-attention + FFN block (decoder-only stack —
+    no cross-attention, the GPT layer shape)."""
+
+    def __init__(self, model_dim, num_heads, ffn_dim, dropout=0.1,
+                 dtype=jnp.float32, fused_qkv=False):
+        super().__init__()
+        self.attn = MultiHeadAttention(model_dim, num_heads, dropout, dtype,
+                                       fused_qkv=fused_qkv)
+        self.ffn = FeedForward(model_dim, ffn_dim, dropout, dtype)
+        self.ln1 = LayerNorm()
+        self.ln2 = LayerNorm()
+        self.drop = Dropout(dropout)
+
+    def forward(self, cx: Context, x, mask=None, cache=None,
+                decode_pos=None):
+        # training path: block-causal flash; decode path: mask carries
+        # the <=pos constraint (cache rows past pos are zeros)
+        h, nc = self.attn(cx, self.ln1(cx, x), mask=mask,
+                          causal=cache is None, cache=cache,
+                          decode_pos=decode_pos)
+        x = x + self.drop(cx, h)
+        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        return x, nc
+
+
+class CausalLM(Module):
+    """Decoder-only autoregressive LM (GPT-style).
+
+    The reference's LM story tops out at RNN language models
+    (stacked_dynamic_lstm benchmark, seq2seq book chapter); this is the
+    modern-capability equivalent on the same stack the Transformer
+    family uses — and the single-chip long-context flagship: causal
+    attention dispatches to the Pallas flash kernel (kernels/flash.py,
+    O(T) memory), and `return_hidden=True` pairs with
+    ops.fused_ce.linear_cross_entropy so a [T, V] logits tensor never
+    materializes — together they hold peak activation linear in T at
+    16k+ token sequences.
+
+    tie_embeddings=True (default) shares the token table with the
+    output head (Embedding.attend)."""
+
+    def __init__(self, vocab: int, model_dim: int = 512,
+                 num_heads: int = 8, num_layers: int = 6,
+                 ffn_dim: int = 2048, dropout: float = 0.1,
+                 max_len: int = 2048, tie_embeddings: bool = True,
+                 dtype=jnp.float32, fused_qkv: bool = False):
+        super().__init__()
+        self.model_dim = model_dim
+        self.max_len = max_len
+        self.vocab = vocab
+        self.tie_embeddings = tie_embeddings
+        self.dtype = dtype
+        self.embed = Embedding(vocab, model_dim, dtype=dtype)
+        self.blocks = [CausalBlock(model_dim, num_heads, ffn_dim, dropout,
+                                   dtype, fused_qkv)
+                       for _ in range(num_layers)]
+        self.ln_f = LayerNorm()
+        if not tie_embeddings:
+            self.head = Linear(vocab, dtype=dtype)
+        self.drop = Dropout(dropout)
+
+    def _head(self, cx: Context, x):
+        return (self.embed.attend(cx, x) if self.tie_embeddings
+                else self.head(cx, x))
+
+    def forward(self, cx: Context, tokens, return_hidden: bool = False):
+        """tokens [B, T] -> logits [B, T, V] (or pre-head hidden [B, T, D]
+        with return_hidden — feed ops.fused_ce.linear_cross_entropy with
+        head_weights(variables))."""
+        t = tokens.shape[1]
+        if t > self.max_len:
+            raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
+        x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
+        x = x + sinusoid_position_encoding(t, self.model_dim).astype(x.dtype)
+        x = self.drop(cx, x)
+        for blk in self.blocks:
+            x, _ = blk(cx, x)
+        x = self.ln_f(cx, x)
+        if return_hidden:
+            self._head(cx, x[:1, :1])   # touch head params for init trace
+            return x
+        return self._head(cx, x)
+
+    def head_weights(self, variables):
+        """([D, V] weight, bias or None) for linear_cross_entropy — the
+        tied table transposed, or the untied head params."""
+        if self.tie_embeddings:
+            return variables[PARAMS]["embed"]["weight"].T, None
+        head = variables[PARAMS]["head"]
+        return head["weight"], head["bias"]
+
+    # -- incremental decode -------------------------------------------------
+    def init_cache(self, batch: int, max_len: Optional[int] = None):
+        max_len = max_len or self.max_len
+        h = self.blocks[0].attn.num_heads
+        hd = self.blocks[0].attn.head_dim
+        return [{"k": jnp.zeros((batch, max_len, h, hd), jnp.float32),
+                 "v": jnp.zeros((batch, max_len, h, hd), jnp.float32)}
+                for _ in self.blocks]
+
+    def decode_step(self, cx: Context, token, pos, caches):
+        """One step: token [B] ids at position `pos` -> (logits [B, V],
+        new caches). Mirrors Transformer.decode_step."""
+        x = self.embed(cx, token[:, None]) * math.sqrt(self.model_dim)
+        pe = jax.lax.dynamic_slice_in_dim(
+            sinusoid_position_encoding(self.max_len, self.model_dim),
+            pos, 1, axis=0)
+        x = x + pe.astype(x.dtype)[None]
+        tmax = caches[0]["k"].shape[1]
+        smask = (jnp.arange(tmax)[None, None, None, :] <= pos)
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, nc = blk(cx, x, mask=smask, cache=cache, decode_pos=pos)
+            new_caches.append(nc)
+        return self._head(cx, self.ln_f(cx, x))[:, 0], new_caches
+
+    def generate(self, variables, prompt, num_steps: int,
+                 rng: Optional[jax.Array] = None,
+                 temperature: float = 0.0) -> jax.Array:
+        """KV-cached autoregressive continuation: [B, T0] prompt ->
+        [B, T0+steps]. Greedy at temperature 0, else softmax sampling.
+        O(T) per step via decode_step (PipelinedLM.generate is the
+        recompute variant; this is the serving-scale path)."""
+        from paddle_tpu.core.module import _CtxCore
+        b, t0 = prompt.shape
+        if t0 < 1:
+            raise ValueError("generate needs a non-empty prompt")
+        total = t0 + num_steps
+        if total > self.max_len:
+            raise ValueError(f"prompt {t0} + steps {num_steps} exceeds "
+                             f"max_len {self.max_len}")
+        if temperature > 0.0 and rng is None:
+            raise ValueError("sampling (temperature > 0) needs an rng")
+        tokens = jnp.zeros((b, total), jnp.int32)
+        tokens = tokens.at[:, :t0].set(prompt.astype(jnp.int32))
+        caches = self.init_cache(b, total)
+
+        def body(i, carry):
+            tok, caches = carry
+            cx = Context(_CtxCore(mode="apply", variables=variables,
+                                  mutated={}, rng=None, rng_count=0,
+                                  training=False))
+            logits, caches = self.decode_step(cx, tok[:, i], i, caches)
+            if temperature > 0.0:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(rng, i),
+                    logits.astype(jnp.float32) / temperature)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # prompt positions keep their token; continuations append
+            # (i ranges over [0, total-1), so i + 1 is always in range)
+            nxt = jnp.where(i + 1 < t0, tok[:, i + 1], nxt.astype(jnp.int32))
+            tok = jax.lax.dynamic_update_slice_in_dim(
+                tok, nxt[:, None], i + 1, axis=1)
+            return tok, caches
+
+        tokens, _ = jax.lax.fori_loop(0, total - 1, body, (tokens, caches))
+        return tokens
 
 
 class BertEncoder(Module):

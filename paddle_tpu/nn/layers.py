@@ -512,7 +512,13 @@ class Embedding(Module):
         return out
 
     def attend(self, cx: Context, x):
-        """Tied-softmax projection: x @ table.T (for LM output heads)."""
+        """Tied-softmax projection: x @ table.T (for LM output heads).
+
+        Self-scopes like Module.__call__ so the lookup resolves to THIS
+        module's "weight" — called bare with the parent's cx it would
+        otherwise silently create an independent parent-level param and
+        break the tie (the bug this fixed in BertEncoder's MLM head)."""
+        cx = cx.scope(self._name or type(self).__name__)
         table = cx.param("weight", (self.num_embeddings, self.features),
                          self.embedding_init, self.param_dtype)
         return jnp.matmul(x.astype(self.dtype),

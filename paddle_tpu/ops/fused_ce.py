@@ -51,6 +51,21 @@ def effective_chunk(v: int, chunk: int = DEFAULT_CHUNK) -> int:
     return min(chunk, _num_chunks(v, 256) * 256)
 
 
+def mfu_flops_correction(n_tokens: int, dim: int, vocab: int,
+                         chunk: int = DEFAULT_CHUNK) -> float:
+    """Analytic FLOPs to ADD to a compiled-executable count so a step
+    using linear_cross_entropy reports MFU on the same model-FLOPs basis
+    as the unfused head (remat convention: recompute is not useful work).
+
+    Unfused head path = 6*N*D*V (fwd logits + two bwd matmuls). XLA's
+    cost analysis counts each fused-CE scan body exactly once: fwd
+    2*N*D*chunk + bwd 6*N*D*chunk (recompute, dl@wc^T, h^T@dl) =
+    8*N*D*chunk already counted. Negative when the whole vocab fits one
+    chunk (counted recompute exceeds the model basis) — still correct."""
+    c = effective_chunk(vocab, chunk)
+    return float(n_tokens) * dim * (6.0 * vocab - 8.0 * c)
+
+
 def _chunk_logits(h, w, b, i, chunk):
     """f32 logits for vocab chunk i: [N, chunk], padded cols forced to
     -inf. w is pre-padded to a chunk multiple by the wrapper."""

@@ -125,6 +125,15 @@ def test_bert_encoder_mlm(rng):
     flat = jax.tree_util.tree_leaves_with_path(v["params"])
     names = ["/".join(str(k) for k in path) for path, _ in flat]
     assert not any("head" in n for n in names)
+    # ...and REALLY reuses it: no rogue root-level "weight" param
+    # (Embedding.attend once resolved in the parent scope), and bumping
+    # the embed table must move the MLM logits
+    assert "weight" not in v["params"], list(v["params"])
+    v2 = jax.tree.map(lambda x: x, v)
+    v2["params"]["embed"]["weight"] = (
+        v["params"]["embed"]["weight"] + 0.1)
+    assert not np.allclose(np.asarray(m.apply(v2, toks, pos)),
+                           np.asarray(logits))
     # bidirectional: changing a NON-masked token moves the masked logits
     toks2 = toks.at[0, 5].set((toks[0, 5] + 1) % 50)
     assert pos[0, 0] != 5 and pos[0, 1] != 5
