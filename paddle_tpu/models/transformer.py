@@ -44,6 +44,20 @@ def sinusoid_position_encoding(maxlen: int, dim: int) -> jnp.ndarray:
                            axis=-1).astype(jnp.float32)
 
 
+def init_kv_caches(layers, batch: int, max_len: int):
+    """Zeroed per-layer KV caches for incremental decode: one
+    {"k","v"} [B, max_len, H, hd] dict per layer. `layers` are modules
+    whose attention child exposes num_heads/head_dim (DecoderLayer
+    .self_attn, CausalBlock .attn). Shared by Transformer.init_cache
+    and CausalLM.init_cache so the cache layout has one definition."""
+    first = layers[0]
+    attn = getattr(first, "self_attn", None) or first.attn
+    h, hd = attn.num_heads, attn.head_dim
+    return [{"k": jnp.zeros((batch, max_len, h, hd), jnp.float32),
+             "v": jnp.zeros((batch, max_len, h, hd), jnp.float32)}
+            for _ in layers]
+
+
 class MultiHeadAttention(Module):
     """MHA with optional KV cache; names match transformer_tp_rules.
 
@@ -251,12 +265,8 @@ class Transformer(Module):
 
     # -- incremental decode (for beam search) ------------------------------
     def init_cache(self, batch: int, max_len: Optional[int] = None):
-        max_len = max_len or self.max_len
-        h, hd = self.dec_layers[0].self_attn.num_heads, \
-            self.dec_layers[0].self_attn.head_dim
-        return [{"k": jnp.zeros((batch, max_len, h, hd), jnp.float32),
-                 "v": jnp.zeros((batch, max_len, h, hd), jnp.float32)}
-                for _ in self.dec_layers]
+        return init_kv_caches(self.dec_layers, batch,
+                              max_len or self.max_len)
 
     def decode_step(self, cx: Context, token, pos, memory, caches,
                     src_mask=None):
@@ -374,12 +384,26 @@ class CausalLM(Module):
 
     # -- incremental decode -------------------------------------------------
     def init_cache(self, batch: int, max_len: Optional[int] = None):
-        max_len = max_len or self.max_len
-        h = self.blocks[0].attn.num_heads
-        hd = self.blocks[0].attn.head_dim
-        return [{"k": jnp.zeros((batch, max_len, h, hd), jnp.float32),
-                 "v": jnp.zeros((batch, max_len, h, hd), jnp.float32)}
-                for _ in self.blocks]
+        return init_kv_caches(self.blocks, batch, max_len or self.max_len)
+
+    def prefill(self, cx: Context, tokens, caches):
+        """ONE parallel pass over a [B, T0] prompt that populates the KV
+        caches (writes k/v for positions [0, T0) in a single
+        dynamic_update_slice per layer) and returns the last position's
+        logits — O(1) forwards instead of O(T0) decode_steps."""
+        t0 = tokens.shape[1]
+        x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)[:t0]
+        x = x + pe.astype(x.dtype)[None]
+        tmax = caches[0]["k"].shape[1]
+        # per-query causal mask over the cache row space
+        mask = (jnp.arange(tmax)[None, :]
+                <= jnp.arange(t0)[:, None])[None, None]
+        new_caches = []
+        for blk, cache in zip(self.blocks, caches):
+            x, nc = blk(cx, x, mask=mask, cache=cache, decode_pos=0)
+            new_caches.append(nc)
+        return self._head(cx, self.ln_f(cx, x[:, -1:]))[:, 0], new_caches
 
     def decode_step(self, cx: Context, token, pos, caches):
         """One step: token [B] ids at position `pos` -> (logits [B, V],
@@ -402,8 +426,10 @@ class CausalLM(Module):
                  temperature: float = 0.0) -> jax.Array:
         """KV-cached autoregressive continuation: [B, T0] prompt ->
         [B, T0+steps]. Greedy at temperature 0, else softmax sampling.
-        O(T) per step via decode_step (PipelinedLM.generate is the
-        recompute variant; this is the serving-scale path)."""
+        One parallel `prefill` pass populates the caches for the whole
+        prompt, then each continuation token is one O(T) decode_step
+        (PipelinedLM.generate is the recompute variant; this is the
+        serving-scale path)."""
         from paddle_tpu.core.module import _CtxCore
         b, t0 = prompt.shape
         if t0 < 1:
@@ -414,30 +440,39 @@ class CausalLM(Module):
                              f"max_len {self.max_len}")
         if temperature > 0.0 and rng is None:
             raise ValueError("sampling (temperature > 0) needs an rng")
-        tokens = jnp.zeros((b, total), jnp.int32)
-        tokens = tokens.at[:, :t0].set(prompt.astype(jnp.int32))
-        caches = self.init_cache(b, total)
+        prompt = prompt.astype(jnp.int32)
+        if num_steps == 0:
+            return prompt
 
-        def body(i, carry):
-            tok, caches = carry
-            cx = Context(_CtxCore(mode="apply", variables=variables,
-                                  mutated={}, rng=None, rng_count=0,
-                                  training=False))
-            logits, caches = self.decode_step(cx, tok[:, i], i, caches)
+        def fresh_cx():
+            return Context(_CtxCore(mode="apply", variables=variables,
+                                    mutated={}, rng=None, rng_count=0,
+                                    training=False))
+
+        def sample(logits, i):
+            # i = the position of the query that produced these logits
             if temperature > 0.0:
-                nxt = jax.random.categorical(
+                return jax.random.categorical(
                     jax.random.fold_in(rng, i),
-                    logits.astype(jnp.float32) / temperature)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
-            # prompt positions keep their token; continuations append
-            # (i ranges over [0, total-1), so i + 1 is always in range)
-            nxt = jnp.where(i + 1 < t0, tok[:, i + 1], nxt.astype(jnp.int32))
+                    logits.astype(jnp.float32) / temperature
+                ).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        logits0, caches = self.prefill(fresh_cx(), prompt,
+                                       self.init_cache(b, total))
+        tokens = jnp.zeros((b, total), jnp.int32).at[:, :t0].set(prompt)
+        tokens = tokens.at[:, t0].set(sample(logits0, t0 - 1))
+
+        def body(i, carry):        # i in [t0, total-1): extend by one
+            tok, caches = carry
+            logits, caches = self.decode_step(fresh_cx(), tok[:, i], i,
+                                              caches)
             tok = jax.lax.dynamic_update_slice_in_dim(
-                tok, nxt[:, None], i + 1, axis=1)
+                tok, sample(logits, i)[:, None], i + 1, axis=1)
             return tok, caches
 
-        tokens, _ = jax.lax.fori_loop(0, total - 1, body, (tokens, caches))
+        tokens, _ = jax.lax.fori_loop(t0, total - 1, body,
+                                      (tokens, caches))
         return tokens
 
 
@@ -486,6 +521,17 @@ class BertEncoder(Module):
         hidden = self.ln(cx, x)
         if mask_positions is None:
             return hidden
+        # Pre-scoping-fix checkpoints carry a rogue root-level "weight"
+        # (Embedding.attend once resolved in the PARENT scope, so the
+        # "tied" head trained an independent matrix). Silently ignoring
+        # it would change this model's MLM logits — fail loudly instead.
+        if "weight" in cx._core.variables.get(PARAMS, {}):
+            from paddle_tpu.core.module import ModuleError
+            raise ModuleError(
+                "checkpoint has a root-level 'weight' param: it predates "
+                "the Embedding.attend scoping fix and its MLM head was "
+                "NOT tied. Migrate by renaming it into a dedicated head "
+                "or folding it into params['embed']['weight'].")
         picked = jnp.take_along_axis(
             hidden, mask_positions[..., None].astype(jnp.int32), axis=1)
         return self.embed.attend(cx, picked)

@@ -4,6 +4,7 @@ recommender + dist_ctr model checks)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu.core.executor import Trainer
 from paddle_tpu.models import (
@@ -134,6 +135,13 @@ def test_bert_encoder_mlm(rng):
         v["params"]["embed"]["weight"] + 0.1)
     assert not np.allclose(np.asarray(m.apply(v2, toks, pos)),
                            np.asarray(logits))
+    # a pre-scoping-fix checkpoint (rogue root 'weight' = the untied MLM
+    # head it actually trained) must fail loudly, not silently re-tie
+    from paddle_tpu.core.module import ModuleError
+    v3 = jax.tree.map(lambda x: x, v)
+    v3["params"]["weight"] = np.zeros((50, 32), np.float32)
+    with pytest.raises(ModuleError, match="scoping fix"):
+        m.apply(v3, toks, pos)
     # bidirectional: changing a NON-masked token moves the masked logits
     toks2 = toks.at[0, 5].set((toks[0, 5] + 1) % 50)
     assert pos[0, 0] != 5 and pos[0, 1] != 5
