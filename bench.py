@@ -227,6 +227,40 @@ def _moe_bench(min_time: float = 1.0):
     return out
 
 
+def _decode_bench(min_time: float = 1.0):
+    """Autoregressive decode throughput: CausalLM.generate (parallel
+    prefill + KV-cached steps) at the lm_longctx model size — the
+    serving-side number next to the training tok/s (reference analog:
+    the inference latency tables, BASELINE.md infer rows)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.benchmark.harness import run_timed
+    from paddle_tpu.models.transformer import CausalLM
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bs, t0, steps = (8, 32, 256) if on_tpu else (2, 8, 16)
+    model = CausalLM(32000, model_dim=512, num_heads=8, num_layers=6,
+                     ffn_dim=2048, dropout=0.0, max_len=t0 + steps,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    rs = np.random.RandomState(0)
+    tok = jnp.asarray(rs.randint(0, 32000, (bs, t0)), jnp.int32)
+    variables = model.init(jax.random.key(0), tok)
+    gen = jax.jit(lambda v, pr: model.generate(v, pr, steps))
+
+    def step(pr):
+        out = gen(variables, pr)
+        # loop-carry the prompt from the output so the axon pool cannot
+        # serve a cached result for a repeated identical dispatch
+        return out[:, -t0:], out
+
+    sec, _, _ = run_timed(step, tok, min_time=min_time)
+    return {"decode_tokens_per_sec": round(bs * steps / sec, 1),
+            "decode_ms_per_token": round(sec / steps * 1e3, 3),
+            "decode_bs": bs, "decode_steps": steps}
+
+
 def _resnet_s2d(min_time: float, bs: int = 128):
     """ResNet-50 with the space-to-depth stem (equivalent-capacity
     reparameterization; PERF_NOTES.md addendum)."""
@@ -459,6 +493,12 @@ def main():
                                          if s2d.mfu else None)
         except Exception as e:
             extra["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("decode", est_s=150):  # KV-cached generate throughput
+        try:
+            extra.update(_retry(lambda: _decode_bench(min_time=min_time)))
+        except Exception as e:
+            extra["decode_error"] = f"{type(e).__name__}: {e}"[:160]
 
     if _gate("scaling", est_s=240, tpu_only=False):  # weak-scaling sweep (cpu-mesh subprocess)
         try:

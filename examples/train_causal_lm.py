@@ -80,10 +80,11 @@ def main():
 
     # KV-cache generation: the (t+3)%V stream is learnable, so the
     # continuation should keep stepping by 3
-    prompt = jnp.asarray(tok[:2, :8])
+    p0 = min(8, args.seq)              # stay inside max_len for tiny --seq
+    prompt = jnp.asarray(tok[:2, :p0])
     cont = model.generate(ts.variables, prompt, num_steps=8)
     print("prompt     :", np.asarray(prompt[0]))
-    print("continued  :", np.asarray(cont[0, 8:]))
+    print("continued  :", np.asarray(cont[0, p0:]))
     want = (np.asarray(prompt[0, -1]) + 3 * np.arange(1, 9)) % args.vocab
     print("ideal      :", want)
 

@@ -97,12 +97,18 @@ class MultiHeadAttention(Module):
         return x.reshape(b, t, self.num_heads, self.head_dim)
 
     def forward(self, cx: Context, q, kv=None, mask=None, causal=False,
-                cache: Optional[Dict] = None, decode_pos=None):
+                cache: Optional[Dict] = None, decode_pos=None,
+                prefill: bool = False):
         """q: [B, Tq, D]; kv: [B, Tk, D] (None = self-attention).
         mask: broadcastable to [B, heads, Tq, Tk], True = attend.
         causal: block-wise causal masking — forwarded to the flash kernel
         (a dense causal mask would force the XLA reference path).
-        cache: {"k","v"} [B, Tmax, H, Hd] updated at decode_pos."""
+        cache: {"k","v"} [B, Tmax, H, Hd] updated at decode_pos.
+        prefill: write the cache but attend only over THIS call's
+        [B, Tq] k/v (set causal=True) — the whole-prompt cache warmup.
+        Attending over the full Tmax cache here would both force the
+        dense path (explicit mask) and score the empty future rows:
+        O(Tq x Tmax) f32, which cannot reach long contexts."""
         kv_in = q if kv is None else kv
         if self.fused_qkv and kv is None:
             b, t = q.shape[:2]
@@ -127,7 +133,8 @@ class MultiHeadAttention(Module):
             v_all = jax.lax.dynamic_update_slice_in_dim(
                 cache["v"], vh.astype(cache["v"].dtype), decode_pos, axis=1)
             cache = {"k": k_all, "v": v_all}
-            kh, vh = k_all, v_all
+            if not prefill:
+                kh, vh = k_all, v_all
 
         from paddle_tpu.kernels import attention as attn_kernel
         out = attn_kernel.mha(qh, kh, vh, mask=mask, causal=causal,
@@ -305,12 +312,12 @@ class CausalBlock(Module):
         self.drop = Dropout(dropout)
 
     def forward(self, cx: Context, x, mask=None, cache=None,
-                decode_pos=None):
-        # training path: block-causal flash; decode path: mask carries
-        # the <=pos constraint (cache rows past pos are zeros)
+                decode_pos=None, prefill=False):
+        # training/prefill: block-causal flash over this call's k/v;
+        # decode: mask carries the <=pos constraint over the cache
         h, nc = self.attn(cx, self.ln1(cx, x), mask=mask,
-                          causal=cache is None, cache=cache,
-                          decode_pos=decode_pos)
+                          causal=cache is None or prefill, cache=cache,
+                          decode_pos=decode_pos, prefill=prefill)
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x, nc
@@ -390,18 +397,17 @@ class CausalLM(Module):
         """ONE parallel pass over a [B, T0] prompt that populates the KV
         caches (writes k/v for positions [0, T0) in a single
         dynamic_update_slice per layer) and returns the last position's
-        logits — O(1) forwards instead of O(T0) decode_steps."""
+        logits — O(1) forwards instead of O(T0) decode_steps. Attention
+        runs block-causal over the T0-length k/v (flash-capable — NOT a
+        dense mask over the full cache), so prefill reaches the same
+        sequence lengths training does."""
         t0 = tokens.shape[1]
         x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
         pe = sinusoid_position_encoding(self.max_len, self.model_dim)[:t0]
         x = x + pe.astype(x.dtype)[None]
-        tmax = caches[0]["k"].shape[1]
-        # per-query causal mask over the cache row space
-        mask = (jnp.arange(tmax)[None, :]
-                <= jnp.arange(t0)[:, None])[None, None]
         new_caches = []
         for blk, cache in zip(self.blocks, caches):
-            x, nc = blk(cx, x, mask=mask, cache=cache, decode_pos=0)
+            x, nc = blk(cx, x, cache=cache, decode_pos=0, prefill=True)
             new_caches.append(nc)
         return self._head(cx, self.ln_f(cx, x[:, -1:]))[:, 0], new_caches
 

@@ -125,3 +125,19 @@ def test_trains_with_fused_ce():
         ts, out = tr.train_step(ts, (tok, targets), rng=jax.random.key(i))
         losses.append(float(out["loss"]))
     assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_export_and_serve(tmp_path):
+    """CausalLM plugs into the serving story: save_inference_model +
+    InferencePredictor reproduce the in-process logits."""
+    from paddle_tpu.io.inference import (InferencePredictor,
+                                         save_inference_model)
+
+    model, variables, tok = _model_and_tokens(seed=6)
+    d = str(tmp_path / "clm")
+    save_inference_model(d, model, variables, [tok],
+                         input_names=["tokens"])
+    served = InferencePredictor(d).run([np.asarray(tok)])[0]
+    want = model.apply(variables, tok)
+    np.testing.assert_allclose(served, np.asarray(want), rtol=2e-5,
+                               atol=2e-5)
