@@ -237,15 +237,16 @@ def _decode_bench(min_time: float = 1.0):
     import numpy as np
 
     from paddle_tpu.benchmark.harness import run_timed
+    from paddle_tpu.benchmark.models import LM_BASE, LM_VOCAB
     from paddle_tpu.models.transformer import CausalLM
 
     on_tpu = jax.devices()[0].platform == "tpu"
     bs, t0, steps = (8, 32, 256) if on_tpu else (2, 8, 16)
-    model = CausalLM(32000, model_dim=512, num_heads=8, num_layers=6,
-                     ffn_dim=2048, dropout=0.0, max_len=t0 + steps,
-                     dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = CausalLM(LM_VOCAB, max_len=t0 + steps,
+                     dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                     **LM_BASE)
     rs = np.random.RandomState(0)
-    tok = jnp.asarray(rs.randint(0, 32000, (bs, t0)), jnp.int32)
+    tok = jnp.asarray(rs.randint(0, LM_VOCAB, (bs, t0)), jnp.int32)
     variables = model.init(jax.random.key(0), tok)
     gen = jax.jit(lambda v, pr: model.generate(v, pr, steps))
 

@@ -145,8 +145,15 @@ def _transformer(name, batch_size, dtype, mesh, strategy, rules, min_time,
                          extra_flops=extra_flops)
 
 
+# CausalLM size shared by the lm_longctx train bench and bench.py's
+# _decode_bench ("same model size" must stay true by construction)
+LM_BASE = dict(model_dim=512, num_heads=8, num_layers=6, ffn_dim=2048,
+               dropout=0.0)
+LM_VOCAB = 32000
+
+
 def _lm_longctx(name, batch_size, dtype, mesh, strategy, rules, min_time,
-                seq_len: int = 16384, vocab: int = 32000):
+                seq_len: int = 16384, vocab: int = LM_VOCAB):
     """Single-chip long-context causal-LM train step: CausalLM with
     block-causal Pallas flash attention (O(T) score memory) + the
     chunked fused CE (no [T, V] logits) — the pairing that makes
@@ -166,10 +173,9 @@ def _lm_longctx(name, batch_size, dtype, mesh, strategy, rules, min_time,
                                          mfu_flops_correction)
 
     bs = batch_size or 1
-    dim, heads, layers = 512, 8, 6
-    model = CausalLM(vocab, model_dim=dim, num_heads=heads,
-                     num_layers=layers, ffn_dim=2048, dropout=0.0,
-                     max_len=seq_len, dtype=dtype)
+    dim = LM_BASE["model_dim"]
+    heads, layers = LM_BASE["num_heads"], LM_BASE["num_layers"]
+    model = CausalLM(vocab, max_len=seq_len, dtype=dtype, **LM_BASE)
 
     def loss_fn(module, variables, batch, rng, training):
         inp, tgt = batch
