@@ -66,35 +66,49 @@ def would_use_flash(q_shape, k_shape, has_mask: bool = False,
     benchmark/models.py — the flash custom call scores 0 flops in XLA's
     cost analysis) evaluate the same predicate, not a copy.
 
-    The kernel pads ragged sequence lengths to block multiples itself,
+    The kernel pads ragged sequence lengths to block multiples itself and
+    (round 5) handles segment-id masking and attention dropout in-kernel,
     so the gate only excludes: shapes where XLA's dense attention is
-    simply faster, head dims the MXU tiles badly, dropout, and arbitrary
-    dense masks. Measured on v5e (fwd+bwd, bf16, causal): XLA wins 3.6x
-    at T=256; flash wins 1.9x at T=1024 and is the only feasible path at
-    16k+ (the [B,H,Tq,Tk] score tensor stops fitting) — so the gate is
-    the kv length crossing 512."""
+    simply faster, head dims the MXU tiles badly, and arbitrary dense
+    masks. `dropout_rate` is accepted for signature compatibility but no
+    longer gates — dropout>0 does not change the dispatch. Measured on
+    v5e (fwd+bwd, bf16, causal): XLA wins 3.6x at T=256; flash wins 1.9x
+    at T=1024 and is the only feasible path at 16k+ (the [B,H,Tq,Tk]
+    score tensor stops fitting) — so the gate is the kv length crossing
+    512."""
+    del dropout_rate  # in-kernel dropout: no longer affects dispatch
     return (FLAGS.get("flash_attention") and _on_tpu()
             and not has_mask
-            and dropout_rate == 0.0
             and q_shape[1] >= 64 and k_shape[1] >= 512
             and q_shape[-1] % 32 == 0 and q_shape[-1] <= 256)
 
 
 def mha(q, k, v, mask=None, scale: Optional[float] = None,
         dropout_rng=None, dropout_rate: float = 0.0, causal: bool = False,
-        kv_len: Optional[int] = None):
+        kv_len: Optional[int] = None, segment_ids=None):
     """Dispatching multi-head attention entry point used by model code.
 
-    `causal` and `kv_len` (static right-padding length) are forwarded to the
-    flash kernel, which handles them block-wise — materializing them into a
-    dense `mask` would force the XLA reference path. An explicit `mask`
-    (arbitrary pattern) always uses the reference path.
+    `causal`, `kv_len` (static right-padding length) and `segment_ids`
+    ([B, T] int32 packed-batch ids, or a (q_seg, kv_seg) pair; tokens
+    attend only where ids match) are forwarded to the flash kernel, which
+    handles them block-wise — materializing them into a dense `mask` would
+    force the XLA reference path. Dropout runs in-kernel on the flash path
+    (same distribution as the reference path's bernoulli, different bits).
+    An explicit `mask` (arbitrary pattern) always uses the reference path.
     """
-    if would_use_flash(q.shape, k.shape, has_mask=mask is not None,
-                       dropout_rate=dropout_rate):
+    if would_use_flash(q.shape, k.shape, has_mask=mask is not None):
         from paddle_tpu.kernels import flash
         return flash.flash_attention(q, k, v, scale=scale, causal=causal,
-                                     kv_len=kv_len)
+                                     kv_len=kv_len, segment_ids=segment_ids,
+                                     dropout_rate=dropout_rate,
+                                     dropout_rng=dropout_rng)
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_seg, kv_seg = segment_ids
+        else:
+            q_seg = kv_seg = segment_ids
+        smask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
+        mask = smask if mask is None else jnp.logical_and(mask, smask)
     if causal:
         t_q, t_k = q.shape[1], k.shape[1]
         cmask = (jnp.arange(t_k)[None, :] <= jnp.arange(t_q)[:, None]

@@ -17,9 +17,24 @@ Design (TPU-idiomatic, layout [BH, T, D]):
   Q/dO blocks per k block. Both recompute p = exp(s - lse) from the saved
   lse instead of storing the [Tq, Tk] probability matrix.
 
-Supports causal masking and right-padding via `kv_len`; blocks entirely
-above the causal diagonal are skipped. Dropout and arbitrary dense masks
-fall back to the XLA reference path in kernels/attention.py.
+Structured masking (all handled block-wise, never as a dense [Tq, Tk]
+tensor):
+- `causal` + `kv_len` right-padding, as before;
+- `segment_ids` — packed ragged batches (the reference's LoD→dense packing
+  idiom, lod_tensor.h:44-58; SURVEY §5.7): tokens attend only within their
+  own segment. Blocks whose q/kv segment ranges do not overlap are SKIPPED
+  (block-sparse), so a packed batch of short documents costs
+  ~sum(len_i^2), not T^2.
+- `dropout_rate` — in-kernel attention dropout via a stateless integer
+  hash (murmur3 finalizer) on (seed, batch*head, q_pos, k_pos). Using
+  global positions makes the keep-mask identical in the forward and both
+  backward kernels regardless of block shape, with no [Tq, Tk] mask
+  materialized. The softmax denominator uses UNdropped probabilities
+  (dropout applies after normalization, matching the XLA reference path's
+  bernoulli-on-probs semantics); only the accumulator sees dropped ones.
+
+Only arbitrary dense masks fall back to the XLA reference path in
+kernels/attention.py.
 
 On CPU (tests) runs in interpret mode so forward and backward numerics are
 validated against reference_attention without TPU hardware.
@@ -42,7 +57,8 @@ except Exception:  # pragma: no cover
     _VMEM = None
 
 NEG_INF = -1e30
-LANES = 128  # f32 lane width: m/l/lse scratch is lane-broadcast
+LANES = 128     # f32 lane width: m/l/lse scratch is lane-broadcast
+SUBLANES = 8    # kv segment ids ride the sublane dim: [B, SUBLANES, Tk]
 
 # Defaults are resolved adaptively in flash_attention() (None = choose by
 # sequence length). Measured on v5e (bf16, causal, fwd+bwd): large square
@@ -76,8 +92,47 @@ def _compiler_params(*semantics):
     return pltpu.CompilerParams(dimension_semantics=semantics)
 
 
-def _block_mask(s, q_start, k_start, *, causal: bool, limit: Optional[int]):
-    """Apply causal / length-bound masking to a [BQ, BK] score block."""
+def _smem_spec():
+    """Whole-array scalar input (the dropout seed) in SMEM."""
+    if pltpu is None:  # pragma: no cover
+        return pl.BlockSpec((1, 1), lambda b, i, j: (0, 0))
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+# --------------------------------------------------------------------------
+# Stateless in-kernel dropout: murmur3-finalizer hash of
+# (seed, bh, q_pos, k_pos). Global positions => the keep-mask is identical
+# across the forward and both backward kernels by construction, independent
+# of block shape.
+# --------------------------------------------------------------------------
+
+def _mix32(x):
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_keep(seed, bh, q_start, k_start, shape, rate: float):
+    """Boolean keep-mask [BQ, BK]; P(drop) = rate (to within 2^-32)."""
+    qpos = (q_start + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+            ).astype(jnp.uint32)
+    kpos = (k_start + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+            ).astype(jnp.uint32)
+    key = _mix32(seed.astype(jnp.uint32)
+                 + bh.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
+    u = _mix32((qpos * jnp.uint32(0x9E3779B1)
+                + kpos * jnp.uint32(0x85EBCA77)) ^ key)
+    return u >= jnp.uint32(rate * 4294967296.0)
+
+
+def _block_mask(s, q_start, k_start, *, causal: bool, limit: Optional[int],
+                q_seg=None, kv_seg=None):
+    """Apply causal / length-bound / segment masking to a [BQ, BK] block.
+
+    q_seg: [BQ, 1] int32; kv_seg: [1, BK] int32 (or both None)."""
     bq, bk = s.shape
     kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     if causal:
@@ -88,22 +143,50 @@ def _block_mask(s, q_start, k_start, *, causal: bool, limit: Optional[int]):
         # final block when t_k % block_k != 0 (pl.ds clamping would
         # otherwise double-count tail rows).
         s = jnp.where(kpos < limit, s, NEG_INF)
+    if q_seg is not None:
+        s = jnp.where(q_seg == kv_seg, s, NEG_INF)
     return s
+
+
+def _seg_block(qseg_ref, kseg_ref):
+    """[BQ, 1] and [1, BK] segment-id slices from the lane/sublane-broadcast
+    block refs (or (None, None))."""
+    if qseg_ref is None:
+        return None, None
+    return qseg_ref[...][:, :1], kseg_ref[...][:1, :]
+
+
+def _contributes(causal, q_start, k_start, block_q, q_seg, kv_seg):
+    """Block-skip predicate: fully-above-diagonal causal blocks and blocks
+    with no segment overlap contribute nothing to the online softmax (m, l,
+    acc unchanged), so their compute is skipped. Segment skipping is what
+    makes packed ragged batches cost ~sum(len_i^2) instead of T^2."""
+    pred = True
+    if causal:
+        pred = k_start <= q_start + block_q - 1
+    if q_seg is not None:
+        overlap = jnp.any(q_seg == kv_seg)
+        pred = overlap if pred is True else jnp.logical_and(pred, overlap)
+    return pred
 
 
 # --------------------------------------------------------------------------
 # Forward
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                limit: Optional[int], want_lse: bool):
-    if want_lse:  # lse residual only materialized for the training path
-        lse_ref, m_scr, l_scr, acc_scr = rest
-    else:
-        lse_ref = None
-        m_scr, l_scr, acc_scr = rest
-    qi, ki = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, limit: Optional[int], want_lse: bool,
+                has_segs: bool, dropout_rate: float):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    qseg_ref = next(it) if has_segs else None
+    kseg_ref = next(it) if has_segs else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    o_ref = next(it)
+    lse_ref = next(it) if want_lse else None
+    m_scr, l_scr, acc_scr = next(it), next(it), next(it)
+
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     q_start = qi * block_q
     k_start = ki * block_k
@@ -114,12 +197,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Blocks fully above the causal diagonal contribute nothing.
-    contributes = True
-    if causal:
-        contributes = k_start <= q_start + block_q - 1
+    q_seg, kv_seg = _seg_block(qseg_ref, kseg_ref)
 
-    @pl.when(contributes)
+    @pl.when(_contributes(causal, q_start, k_start, block_q, q_seg, kv_seg))
     def _compute():
         # Matmul inputs stay in the storage dtype (bf16 on the training
         # path) so the MXU runs at bf16 rate; accumulation and all softmax
@@ -131,14 +211,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [BQ, BK] f32
-        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit)
+        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit,
+                        q_seg=q_seg, kv_seg=kv_seg)
 
         m_prev = m_scr[...][:, :1]                       # [BQ, 1]
         l_prev = l_scr[...][:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
+        # l (the softmax denominator) accumulates UNdropped p: dropout
+        # applies to normalized probabilities, after the softmax.
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, q_start, k_start,
+                                 p.shape, dropout_rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -156,8 +243,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
 
 
-def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret,
-         want_lse):
+def _expand_segs(q_seg, kv_seg):
+    """[B, Tq] / [B, Tk] int32 -> lane-broadcast [B, Tq, LANES] and
+    sublane-broadcast [B, SUBLANES, Tk] (the standard TPU layouts for
+    per-row / per-column scalars)."""
+    b, tq = q_seg.shape
+    tk = kv_seg.shape[1]
+    qs = jax.lax.broadcast_in_dim(q_seg, (b, tq, LANES), (0, 1))
+    ks = jax.lax.broadcast_in_dim(kv_seg, (b, SUBLANES, tk), (0, 2))
+    return qs, ks
+
+
+def _seg_specs(heads: int, block_q: int, block_k: int, *, q_axis, k_axis):
+    """BlockSpecs for the expanded segment-id arrays. Segment ids are per
+    BATCH element while the grid's axis 0 is the flattened batch*heads, so
+    the index maps divide by `heads`. q_axis/k_axis pick which grid axis
+    (1 or 2) indexes q blocks vs k blocks (the dkv kernel swaps them)."""
+    def qmap(b, i, j):
+        g = (b, i, j)
+        return (b // heads, g[q_axis], 0)
+
+    def kmap(b, i, j):
+        g = (b, i, j)
+        return (b // heads, 0, g[k_axis])
+
+    return (pl.BlockSpec((None, block_q, LANES), qmap),
+            pl.BlockSpec((None, SUBLANES, block_k), kmap))
+
+
+def _fwd(q, k, v, q_seg, kv_seg, seed, scale, causal, kv_len, block_q,
+         block_k, interpret, want_lse, dropout_rate, heads):
     """q/k/v: [BH, T, D], T a multiple of the block size (flash_attention
     pads) -> (o [BH, Tq, D], lse [BH, Tq, LANES] f32 | None).
 
@@ -166,13 +281,29 @@ def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret,
     attention output itself at small head dims."""
     bh, t_q, d = q.shape
     t_k = k.shape[1]
-    limit = kv_len
+    has_segs = q_seg is not None
     grid = (bh, pl.cdiv(t_q, block_q), pl.cdiv(t_k, block_k))
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, limit=limit, want_lse=want_lse)
+        block_k=block_k, limit=kv_len, want_lse=want_lse,
+        has_segs=has_segs, dropout_rate=dropout_rate)
     o_spec = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0))
     o_shape = jax.ShapeDtypeStruct((bh, t_q, d), q.dtype)
+    in_specs = [
+        o_spec,
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if has_segs:
+        qs, ks = _expand_segs(q_seg, kv_seg)
+        qspec, kspec = _seg_specs(heads, block_q, block_k, q_axis=1,
+                                  k_axis=2)
+        in_specs += [qspec, kspec]
+        inputs += [qs, ks]
+    if dropout_rate > 0.0:
+        in_specs.append(_smem_spec())
+        inputs.append(seed)
     out_specs = [o_spec]
     out_shape = [o_shape]
     if want_lse:
@@ -182,11 +313,7 @@ def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret,
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            o_spec,
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -196,19 +323,31 @@ def _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret,
         ],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return (out[0], out[1]) if want_lse else (out[0], None)
 
 
 # --------------------------------------------------------------------------
 # Backward: dq kernel (stream K/V per q block), dk/dv kernel (stream Q/dO
 # per k block). Standard flash recompute: p = exp(q·kᵀ·scale − lse).
+# With dropout, ds_ij = p_ij (keep_ij·dp_ij/(1-r) − delta_i) and dv uses
+# g_ij = keep_ij·p_ij/(1-r) — the delta_i = Σ do·o trick still holds
+# because o already includes the dropout.
 # --------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_scr,
-               *, scale: float, causal: bool, block_q: int, block_k: int,
-               limit: Optional[int]):
-    qi, ki = pl.program_id(1), pl.program_id(2)
+def _dq_kernel(*refs, scale: float, causal: bool, block_q: int,
+               block_k: int, limit: Optional[int], has_segs: bool,
+               dropout_rate: float):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, o_ref, lse_ref = next(it), next(it), next(it)
+    qseg_ref = next(it) if has_segs else None
+    kseg_ref = next(it) if has_segs else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dq_ref = next(it)
+    dq_scr = next(it)
+
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
     q_start, k_start = qi * block_q, ki * block_k
 
@@ -216,11 +355,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_scr,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    contributes = True
-    if causal:
-        contributes = k_start <= q_start + block_q - 1
+    q_seg, kv_seg = _seg_block(qseg_ref, kseg_ref)
 
-    @pl.when(contributes)
+    @pl.when(_contributes(causal, q_start, k_start, block_q, q_seg, kv_seg))
     def _compute():
         # bf16 matmul inputs + fp32 accumulation (see _fwd_kernel note)
         q = q_ref[...]
@@ -231,11 +368,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit)
+        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit,
+                        q_seg=q_seg, kv_seg=kv_seg)
         p = jnp.exp(s - lse)                                # [BQ, BK] f32
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BQ, BK]
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, q_start, k_start,
+                                 p.shape, dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         do_f = do.astype(jnp.float32)
         o = o_ref[...].astype(jnp.float32)
         delta = jnp.sum(do_f * o, axis=1, keepdims=True)    # [BQ, 1]
@@ -249,10 +391,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dq_scr,
         dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
-                dk_scr, dv_scr, *, scale: float, causal: bool, block_q: int,
-                block_k: int, limit: Optional[int]):
-    ki, qi = pl.program_id(1), pl.program_id(2)
+def _dkv_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, limit: Optional[int], has_segs: bool,
+                dropout_rate: float):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    do_ref, o_ref, lse_ref = next(it), next(it), next(it)
+    qseg_ref = next(it) if has_segs else None
+    kseg_ref = next(it) if has_segs else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    dk_ref, dv_ref = next(it), next(it)
+    dk_scr, dv_scr = next(it), next(it)
+
+    bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
     q_start, k_start = qi * block_q, ki * block_k
 
@@ -261,11 +412,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    contributes = True
-    if causal:
-        contributes = q_start + block_q - 1 >= k_start
+    q_seg, kv_seg = _seg_block(qseg_ref, kseg_ref)
 
-    @pl.when(contributes)
+    @pl.when(_contributes(causal, q_start, k_start, block_q, q_seg, kv_seg))
     def _compute():
         # bf16 matmul inputs + fp32 accumulation (see _fwd_kernel note)
         q = q_ref[...]
@@ -276,15 +425,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [BQ, BK]
-        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit)
+        s = _block_mask(s, q_start, k_start, causal=causal, limit=limit,
+                        q_seg=q_seg, kv_seg=kv_seg)
         p = jnp.exp(s - lse)
-        p_lo = p.astype(do.dtype)
+        keep = None
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, q_start, k_start,
+                                 p.shape, dropout_rate)
+            g = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        else:
+            g = p
+        g_lo = g.astype(do.dtype)
         dv_scr[...] += jax.lax.dot_general(
-            p_lo, do, (((0,), (0,)), ((), ())),
+            g_lo, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BK, D]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)             # [BQ, BK]
+        if keep is not None:
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         do_f = do.astype(jnp.float32)
         o = o_ref[...].astype(jnp.float32)
         delta = jnp.sum(do_f * o, axis=1, keepdims=True)
@@ -299,68 +458,96 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref,
         dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd_impl(q, k, v, o, lse, do, scale, causal, kv_len, block_q, block_k,
-              interpret):
+def _bwd_impl(q, k, v, o, lse, do, q_seg, kv_seg, seed, scale, causal,
+              kv_len, block_q, block_k, interpret, dropout_rate, heads):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
+    has_segs = q_seg is not None
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, limit=kv_len)
+                  block_k=block_k, limit=kv_len, has_segs=has_segs,
+                  dropout_rate=dropout_rate)
+    seg_inputs = []
+    if has_segs:
+        seg_inputs = list(_expand_segs(q_seg, kv_seg))
+    seed_inputs = [seed] if dropout_rate > 0.0 else []
 
     q_spec = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, i, 0))
     lse_spec = pl.BlockSpec((None, block_q, LANES), lambda b, i, j: (b, i, 0))
     kj_spec = pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, j, 0))
+    dq_in_specs = [q_spec, kj_spec, kj_spec, q_spec, q_spec, lse_spec]
+    if has_segs:
+        qspec, kspec = _seg_specs(heads, block_q, block_k, q_axis=1,
+                                  k_axis=2)
+        dq_in_specs += [qspec, kspec]
+    if dropout_rate > 0.0:
+        dq_in_specs.append(_smem_spec())
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         grid=(bh, pl.cdiv(t_q, block_q), pl.cdiv(t_k, block_k)),
-        in_specs=[q_spec, kj_spec, kj_spec, q_spec, q_spec, lse_spec],
+        in_specs=dq_in_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
-    )(q, k, v, do, o, lse)
+    )(q, k, v, do, o, lse, *seg_inputs, *seed_inputs)
 
     qj_spec = pl.BlockSpec((None, block_q, d), lambda b, i, j: (b, j, 0))
     lsej_spec = pl.BlockSpec((None, block_q, LANES),
                              lambda b, i, j: (b, j, 0))
     ki_spec = pl.BlockSpec((None, block_k, d), lambda b, i, j: (b, i, 0))
+    dkv_in_specs = [qj_spec, ki_spec, ki_spec, qj_spec, qj_spec, lsej_spec]
+    if has_segs:
+        # dkv grid is (bh, k_blocks, q_blocks): q blocks ride grid axis 2
+        qspec, kspec = _seg_specs(heads, block_q, block_k, q_axis=2,
+                                  k_axis=1)
+        dkv_in_specs += [qspec, kspec]
+    if dropout_rate > 0.0:
+        dkv_in_specs.append(_smem_spec())
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, **common),
         grid=(bh, pl.cdiv(t_k, block_k), pl.cdiv(t_q, block_q)),
-        in_specs=[qj_spec, ki_spec, ki_spec, qj_spec, qj_spec, lsej_spec],
+        in_specs=dkv_in_specs,
         out_specs=[ki_spec, ki_spec],
         out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
-    )(q, k, v, do, o, lse)
+    )(q, k, v, do, o, lse, *seg_inputs, *seed_inputs)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------------
-# custom_vjp wiring ([BH, T, D] core)
+# custom_vjp wiring ([BH, T, D] core; segment ids stay [B, T] compact and
+# are lane/sublane-expanded per pallas_call)
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_core(q, k, v, scale, causal, kv_len, block_q, block_k, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, kv_len, block_q, block_k, interpret,
-                want_lse=False)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
+def _flash_core(q, k, v, q_seg, kv_seg, seed, scale, causal, kv_len,
+                block_q, block_k, interpret, dropout_rate, heads):
+    o, _ = _fwd(q, k, v, q_seg, kv_seg, seed, scale, causal, kv_len,
+                block_q, block_k, interpret, want_lse=False,
+                dropout_rate=dropout_rate, heads=heads)
     return o
 
 
-def _flash_core_fwd(q, k, v, scale, causal, kv_len, block_q, block_k,
-                    interpret):
-    o, lse = _fwd(q, k, v, scale, causal, kv_len, block_q, block_k,
-                  interpret, want_lse=True)
-    return o, (q, k, v, o, lse)
+def _flash_core_fwd(q, k, v, q_seg, kv_seg, seed, scale, causal, kv_len,
+                    block_q, block_k, interpret, dropout_rate, heads):
+    o, lse = _fwd(q, k, v, q_seg, kv_seg, seed, scale, causal, kv_len,
+                  block_q, block_k, interpret, want_lse=True,
+                  dropout_rate=dropout_rate, heads=heads)
+    return o, (q, k, v, o, lse, q_seg, kv_seg, seed)
 
 
 def _flash_core_bwd(scale, causal, kv_len, block_q, block_k, interpret,
-                    res, do):
-    q, k, v, o, lse = res
-    return _bwd_impl(q, k, v, o, lse, do, scale, causal, kv_len,
-                     block_q, block_k, interpret)
+                    dropout_rate, heads, res, do):
+    q, k, v, o, lse, q_seg, kv_seg, seed = res
+    dq, dk, dv = _bwd_impl(q, k, v, o, lse, do, q_seg, kv_seg, seed, scale,
+                           causal, kv_len, block_q, block_k, interpret,
+                           dropout_rate, heads)
+    return dq, dk, dv, None, None, None
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -368,23 +555,62 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
                     causal: bool = False, kv_len: Optional[int] = None,
+                    segment_ids=None, dropout_rate: float = 0.0,
+                    dropout_rng=None,
                     block_q: Optional[int] = DEFAULT_BLOCK_Q,
                     block_k: Optional[int] = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None):
     """q: [B, Tq, H, D]; k/v: [B, Tk, H, D] -> [B, Tq, H, D]. Differentiable.
 
-    mask: only None supported here (use causal/kv_len); callers with
-    arbitrary masks must use the reference path — kernels/attention.py
-    dispatches accordingly.
+    segment_ids: packed-ragged-batch masking — either a [B, T] int32 array
+    (self-attention; ids shared by q and kv) or a (q_seg [B, Tq],
+    kv_seg [B, Tk]) pair. Tokens attend only where ids are EQUAL; ids must
+    be >= 0 (internal padding uses -1). Blocks with no segment overlap are
+    skipped entirely (block-sparse). Every real token must be able to
+    attend at least one position (with causal self-attention the diagonal
+    guarantees this); a fully-masked row yields finite garbage, not NaN.
+
+    dropout_rate: in-kernel attention dropout (needs dropout_rng when > 0).
+    The keep pattern is a deterministic function of (rng, batch*head,
+    q_pos, k_pos) — NOT bit-identical to the XLA reference path's
+    bernoulli draw, but the same distribution and exactly reproduced in
+    the backward kernels.
+
+    mask: only None supported here (use causal/kv_len/segment_ids);
+    callers with arbitrary masks must use the reference path —
+    kernels/attention.py dispatches accordingly.
     """
     if mask is not None:
-        raise ValueError("flash_attention handles causal/kv_len only; "
-                         "arbitrary masks use the reference path")
+        raise ValueError("flash_attention handles causal/kv_len/segment_ids "
+                         "only; arbitrary masks use the reference path")
+    if dropout_rate >= 1.0:
+        raise ValueError("dropout_rate must be < 1.0")
     b, t_q, h, d = q.shape
     t_k = k.shape[1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+
+    q_seg = kv_seg = None
+    if segment_ids is not None:
+        if isinstance(segment_ids, (tuple, list)):
+            q_seg, kv_seg = segment_ids
+        else:
+            q_seg = kv_seg = segment_ids
+        q_seg = q_seg.astype(jnp.int32)
+        kv_seg = kv_seg.astype(jnp.int32)
+        if q_seg.shape != (b, t_q) or kv_seg.shape != (b, t_k):
+            raise ValueError(
+                f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
+                f"match q [{b},{t_q}] / kv [{b},{t_k}]")
+
+    seed = None
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            dropout_rate = 0.0  # eval: dropout is a no-op without an rng
+        else:
+            seed = jax.random.randint(dropout_rng, (1, 1), 0, 2**31 - 1,
+                                      dtype=jnp.int32)
 
     if block_q is None or block_k is None:
         if interpret:
@@ -401,22 +627,31 @@ def flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
     # block's *start index*, silently overlapping the previous block, so
     # padding + masking via kv_len is the only correct treatment. Autodiff
     # through pad/slice zero-pads the cotangents for the backward kernels.
+    # Segment ids pad with -1: real ids are >= 0 so real rows never attend
+    # the pad tail, while pad q rows match pad kv columns (keeps their
+    # denominators non-degenerate; those rows are sliced off below).
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
     pad_q = -t_q % block_q
     pad_k = -t_k % block_k
-    if pad_k and kv_len is None:
+    if pad_k and kv_len is None and kv_seg is None:
         kv_len = t_k
     if pad_q:
         q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        if q_seg is not None:
+            q_seg = jnp.pad(q_seg, ((0, 0), (0, pad_q)), constant_values=-1)
     if pad_k:
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
+        if kv_seg is not None:
+            kv_seg = jnp.pad(kv_seg, ((0, 0), (0, pad_k)),
+                             constant_values=-1)
 
     def to_bhtd(x):
         return jnp.transpose(x, (0, 2, 1, 3)).reshape(-1, x.shape[1], d)
 
-    o = _flash_core(to_bhtd(q), to_bhtd(k), to_bhtd(v), scale, causal,
-                    kv_len, block_q, block_k, interpret)
+    o = _flash_core(to_bhtd(q), to_bhtd(k), to_bhtd(v), q_seg, kv_seg, seed,
+                    scale, causal, kv_len, block_q, block_k, interpret,
+                    dropout_rate, h)
     o = jnp.transpose(o.reshape(b, h, t_q + pad_q, d), (0, 2, 1, 3))
     return o[:, :t_q] if pad_q else o

@@ -98,11 +98,16 @@ class MultiHeadAttention(Module):
 
     def forward(self, cx: Context, q, kv=None, mask=None, causal=False,
                 cache: Optional[Dict] = None, decode_pos=None,
-                prefill: bool = False):
+                prefill: bool = False, segment_ids=None):
         """q: [B, Tq, D]; kv: [B, Tk, D] (None = self-attention).
         mask: broadcastable to [B, heads, Tq, Tk], True = attend.
         causal: block-wise causal masking — forwarded to the flash kernel
         (a dense causal mask would force the XLA reference path).
+        segment_ids: [B, T] int32 packed-batch ids (or (q_seg, kv_seg)
+        pair) — tokens attend only within their segment; handled
+        block-wise by the flash kernel (kernels/flash.py), folded into a
+        dense mask on the reference path. The TPU idiom for the
+        reference's LoD ragged batches (lod_tensor.h:44-58).
         cache: {"k","v"} [B, Tmax, H, Hd] updated at decode_pos.
         prefill: write the cache but attend only over THIS call's
         [B, Tq] k/v (set causal=True) — the whole-prompt cache warmup.
@@ -138,6 +143,7 @@ class MultiHeadAttention(Module):
 
         from paddle_tpu.kernels import attention as attn_kernel
         out = attn_kernel.mha(qh, kh, vh, mask=mask, causal=causal,
+                              segment_ids=segment_ids,
                               dropout_rng=(cx.rng() if cx.training and
                                            self.drop.rate > 0 else None),
                               dropout_rate=(self.drop.rate if cx.training
@@ -171,8 +177,9 @@ class EncoderLayer(Module):
         self.ln2 = LayerNorm()
         self.drop = Dropout(dropout)
 
-    def forward(self, cx: Context, x, mask=None):
-        h, _ = self.attn(cx, self.ln1(cx, x), mask=mask)
+    def forward(self, cx: Context, x, mask=None, segment_ids=None):
+        h, _ = self.attn(cx, self.ln1(cx, x), mask=mask,
+                         segment_ids=segment_ids)
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x
@@ -239,10 +246,13 @@ class Transformer(Module):
         x = x + sinusoid_position_encoding(t, self.model_dim).astype(x.dtype)
         x = self.drop(cx, x)
         mask = None
+        segs = None
         if src_lengths is not None:
-            mask = sequence_mask(src_lengths, t)[:, None, None, :]
+            valid = sequence_mask(src_lengths, t)
+            mask = valid[:, None, None, :]       # cross-attn (dense, small)
+            segs = valid.astype(jnp.int32)       # self-attn (flash-capable)
         for layer in self.enc_layers:
-            x = layer(cx, x, mask=mask)
+            x = layer(cx, x, segment_ids=segs)
         return self.enc_ln(cx, x), mask
 
     # -- decoder (teacher-forced training path) ---------------------------
@@ -312,12 +322,13 @@ class CausalBlock(Module):
         self.drop = Dropout(dropout)
 
     def forward(self, cx: Context, x, mask=None, cache=None,
-                decode_pos=None, prefill=False):
+                decode_pos=None, prefill=False, segment_ids=None):
         # training/prefill: block-causal flash over this call's k/v;
         # decode: mask carries the <=pos constraint over the cache
         h, nc = self.attn(cx, self.ln1(cx, x), mask=mask,
                           causal=cache is None or prefill, cache=cache,
-                          decode_pos=decode_pos, prefill=prefill)
+                          decode_pos=decode_pos, prefill=prefill,
+                          segment_ids=segment_ids)
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x, nc
@@ -363,18 +374,33 @@ class CausalLM(Module):
         return (self.embed.attend(cx, x) if self.tie_embeddings
                 else self.head(cx, x))
 
-    def forward(self, cx: Context, tokens, return_hidden: bool = False):
+    def forward(self, cx: Context, tokens, return_hidden: bool = False,
+                segment_ids=None, positions=None):
         """tokens [B, T] -> logits [B, T, V] (or pre-head hidden [B, T, D]
         with return_hidden — feed ops.fused_ce.linear_cross_entropy with
-        head_weights(variables))."""
+        head_weights(variables)).
+
+        segment_ids [B, T] int32: packed ragged batches — several
+        documents share one row, attention never crosses a boundary (and
+        the flash kernel SKIPS non-overlapping blocks, so the packed cost
+        is ~sum(len_i^2), not T^2). Pair with `positions` [B, T] int32
+        (position within each document) so the positional encoding
+        restarts per document; defaults to global 0..T-1. The loss must
+        zero-weight each document's final token (it would predict the
+        next document's first token).
+        """
         t = tokens.shape[1]
         if t > self.max_len:
             raise ValueError(f"sequence {t} exceeds max_len {self.max_len}")
         x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
-        x = x + sinusoid_position_encoding(t, self.model_dim).astype(x.dtype)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)
+        if positions is not None:
+            x = x + pe.astype(x.dtype)[positions]
+        else:
+            x = x + pe[:t].astype(x.dtype)
         x = self.drop(cx, x)
         for blk in self.blocks:
-            x, _ = blk(cx, x)
+            x, _ = blk(cx, x, segment_ids=segment_ids)
         x = self.ln_f(cx, x)
         if return_hidden:
             self._head(cx, x[:1, :1])   # touch head params for init trace
@@ -519,11 +545,16 @@ class BertEncoder(Module):
         x = self.embed(cx, tokens) + self.pos_embed(
             cx, jnp.arange(t, dtype=jnp.int32))[None]
         x = self.drop(cx, x)
-        mask = None
+        # Padding as segment ids (real=1, pad=0) rather than a dense
+        # mask: keeps padded batches on the flash path (the kernel masks
+        # block-wise). Pad rows attend pad rows instead of everything —
+        # their outputs are garbage either way and are never selected by
+        # mask_positions / pooled by callers.
+        segs = None
         if lengths is not None:
-            mask = sequence_mask(lengths, t)[:, None, None, :]
+            segs = sequence_mask(lengths, t).astype(jnp.int32)
         for layer in self.layers:
-            x = layer(cx, x, mask=mask)
+            x = layer(cx, x, segment_ids=segs)
         hidden = self.ln(cx, x)
         if mask_positions is None:
             return hidden

@@ -166,8 +166,9 @@ def _flash_block_fwd(q, k, v, scale, causal, interpret):
     from paddle_tpu.kernels import flash as FL
     b, t_q, h, d = q.shape
     bq, bk = _blk_sizes(t_q, k.shape[1], interpret)
-    o, lse = FL._fwd(_to_bhtd(q), _to_bhtd(k), _to_bhtd(v), scale, causal,
-                     None, bq, bk, interpret, want_lse=True)
+    o, lse = FL._fwd(_to_bhtd(q), _to_bhtd(k), _to_bhtd(v), None, None,
+                     None, scale, causal, None, bq, bk, interpret,
+                     want_lse=True, dropout_rate=0.0, heads=h)
     return _from_bhtd(o, b, h), lse[:, :, :1]
 
 
@@ -179,7 +180,8 @@ def _flash_block_bwd(q, k, v, o, lse_lanes, do, scale, causal, interpret):
     bq, bk = _blk_sizes(t_q, k.shape[1], interpret)
     dq, dk, dv = FL._bwd_impl(
         _to_bhtd(q), _to_bhtd(k), _to_bhtd(v), _to_bhtd(o), lse_lanes,
-        _to_bhtd(do), scale, causal, None, bq, bk, interpret)
+        _to_bhtd(do), None, None, None, scale, causal, None, bq, bk,
+        interpret, 0.0, h)
     return (_from_bhtd(dq, b, h), _from_bhtd(dk, b, h),
             _from_bhtd(dv, b, h))
 
@@ -494,7 +496,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         bq, bk = _blk_sizes(t, t, interpret)
         b, _, hh, _ = qh.shape
         o = FL._flash_core(_to_bhtd(qh), _to_bhtd(kh), _to_bhtd(vh),
-                           scale, causal, None, bq, bk, interpret)
+                           None, None, None, scale, causal, None, bq, bk,
+                           interpret, 0.0, hh)
         o = _from_bhtd(o, b, hh)
         return heads_to_seq(o)          # [B, T/sp, H, D]
 
