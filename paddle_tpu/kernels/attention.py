@@ -33,12 +33,44 @@ NEG_INF = -1e9
 
 def reference_attention(q, k, v, mask=None, scale: Optional[float] = None,
                         dropout_rng=None, dropout_rate: float = 0.0):
-    """Plain XLA attention. q:[B,Tq,H,D] k/v:[B,Tk,H,D] -> [B,Tq,H,D].
+    """Plain XLA attention. q:[B,Tq,H,D] k/v:[B,Tk,Hkv,D] -> [B,Tq,H,D].
 
-    mask: broadcastable to [B, H, Tq, Tk], True = attend.
+    Hkv may divide H (grouped-query / multi-query attention): the grouped
+    einsum never materializes k/v repeated to H heads — at decode time
+    the k/v cache read IS the bandwidth bill, which is the point of GQA.
+
+    mask: broadcastable to [B, H, Tq, Tk] (with GQA, to
+    [B, Hkv, G, Tq, Tk] after a group-dim insert — [B, 1or H, Tq, Tk]
+    masks broadcast either way), True = attend.
     """
     d = q.shape[-1]
+    h, h_kv = q.shape[2], k.shape[2]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    if h != h_kv:
+        if h % h_kv:
+            raise ValueError(f"q heads {h} not a multiple of kv heads "
+                             f"{h_kv}")
+        g = h // h_kv
+        b, tq = q.shape[:2]
+        qg = q.reshape(b, tq, h_kv, g, d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
+        logits = logits.astype(jnp.promote_types(logits.dtype, jnp.float32))
+        if mask is not None:
+            m = mask
+            if m.ndim == 4:  # [B, 1|H, Tq, Tk] -> group layout
+                if m.shape[1] == h:
+                    m = m.reshape(m.shape[0], h_kv, g, *m.shape[2:])
+                else:
+                    m = m[:, :, None]
+            logits = jnp.where(m, logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1)
+        if dropout_rate > 0.0 and dropout_rng is not None:
+            keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+        probs = probs.astype(v.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        return out.reshape(b, tq, h, d)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.promote_types(logits.dtype, jnp.float32))
     if mask is not None:
@@ -98,15 +130,24 @@ def mha(q, k, v, mask=None, scale: Optional[float] = None,
     """
     if would_use_flash(q.shape, k.shape, has_mask=mask is not None):
         from paddle_tpu.kernels import flash
+        if k.shape[2] != q.shape[2]:
+            # GQA prefill/training: the kernel wants equal head counts —
+            # repeat kv heads (compute unchanged; the cache still stores
+            # only Hkv heads, which is where GQA's decode win lives)
+            if q.shape[2] % k.shape[2]:
+                raise ValueError(f"q heads {q.shape[2]} not a multiple "
+                                 f"of kv heads {k.shape[2]}")
+            g = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         return flash.flash_attention(q, k, v, scale=scale, causal=causal,
                                      kv_len=kv_len, segment_ids=segment_ids,
                                      dropout_rate=dropout_rate,
                                      dropout_rng=dropout_rng)
     if segment_ids is not None:
-        if isinstance(segment_ids, (tuple, list)):
-            q_seg, kv_seg = segment_ids
-        else:
-            q_seg = kv_seg = segment_ids
+        from paddle_tpu.kernels.flash import normalize_segment_ids
+        q_seg, kv_seg = normalize_segment_ids(
+            segment_ids, q.shape[0], q.shape[1], k.shape[1])
         smask = (q_seg[:, :, None] == kv_seg[:, None, :])[:, None]
         mask = smask if mask is None else jnp.logical_and(mask, smask)
     if causal:

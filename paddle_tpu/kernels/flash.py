@@ -69,6 +69,25 @@ DEFAULT_BLOCK_Q = None
 DEFAULT_BLOCK_K = None
 
 
+def normalize_segment_ids(segment_ids, b: int, t_q: int, t_k: int):
+    """Normalize the segment_ids argument shared by the flash and dense
+    attention paths: a [B, T] array (self-attention, ids shared by q and
+    kv) or a (q_seg [B, Tq], kv_seg [B, Tk]) pair -> (q_seg, kv_seg)
+    int32, shape-checked. One helper so the two dispatch paths of the
+    same semantic contract cannot drift."""
+    if isinstance(segment_ids, (tuple, list)):
+        q_seg, kv_seg = segment_ids
+    else:
+        q_seg = kv_seg = segment_ids
+    q_seg = q_seg.astype(jnp.int32)
+    kv_seg = kv_seg.astype(jnp.int32)
+    if q_seg.shape != (b, t_q) or kv_seg.shape != (b, t_k):
+        raise ValueError(
+            f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
+            f"match q [{b},{t_q}] / kv [{b},{t_k}]")
+    return q_seg, kv_seg
+
+
 def _default_blocks(t_q: int, t_k: int):
     # v5e-measured: (512,512) best at T<=2048 (2.91 ms @1024/bs16);
     # (512,1024) best at long T (13.95 ms @16k/bs1 vs 27.3 for (256,512)
@@ -593,16 +612,7 @@ def flash_attention(q, k, v, mask=None, scale: Optional[float] = None,
 
     q_seg = kv_seg = None
     if segment_ids is not None:
-        if isinstance(segment_ids, (tuple, list)):
-            q_seg, kv_seg = segment_ids
-        else:
-            q_seg = kv_seg = segment_ids
-        q_seg = q_seg.astype(jnp.int32)
-        kv_seg = kv_seg.astype(jnp.int32)
-        if q_seg.shape != (b, t_q) or kv_seg.shape != (b, t_k):
-            raise ValueError(
-                f"segment_ids shapes {q_seg.shape}/{kv_seg.shape} do not "
-                f"match q [{b},{t_q}] / kv [{b},{t_k}]")
+        q_seg, kv_seg = normalize_segment_ids(segment_ids, b, t_q, t_k)
 
     seed = None
     if dropout_rate > 0.0:

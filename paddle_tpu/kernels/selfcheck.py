@@ -77,7 +77,42 @@ def flash_selfcheck(batch: int = 2, heads: int = 4, seq: int = 1024,
     assert max_rel < atol, (
         f"flash_selfcheck: flash vs reference mismatch: max relative "
         f"error {max_rel:.4f} (tol {atol})")
+
+    # 3. segment-id (packed-batch) masking on hardware: block-sparse
+    # skipping must not change values vs the dense masked reference
+    segs = np.zeros((batch, seq), np.int32)
+    segs[:, seq // 3:] = 1
+    segs[:, 2 * seq // 3:] = 2
+    segs_j = jnp.asarray(segs)
+    s_out = A.mha(q, k, v, causal=causal, segment_ids=segs_j)
+    smask = (segs_j[:, None, :, None] == segs_j[:, None, None, :])
+    if causal:
+        smask = jnp.logical_and(smask, _causal_mask(seq))
+    s_ref = A.reference_attention(q, k, v, mask=smask)
+    seg_err = float(jnp.max(jnp.abs(s_out.astype(jnp.float32)
+                                    - s_ref.astype(jnp.float32)))) / (
+        float(jnp.max(jnp.abs(s_ref.astype(jnp.float32)))) + 1e-6)
+    assert seg_err < atol, (
+        f"flash_selfcheck: segment-id path mismatch: {seg_err:.4f}")
+
+    # 4. in-kernel dropout: deterministic per key, ~rate zeros, and the
+    # no-dropout average is recovered in expectation (loose bound)
+    key = jax.random.PRNGKey(3)
+    d1 = A.mha(q, k, v, causal=causal, dropout_rate=0.5,
+               dropout_rng=key)
+    d2 = A.mha(q, k, v, causal=causal, dropout_rate=0.5,
+               dropout_rng=key)
+    drop_det = float(jnp.max(jnp.abs(d1.astype(jnp.float32)
+                                     - d2.astype(jnp.float32))))
+    assert drop_det == 0.0, (
+        f"flash_selfcheck: dropout not deterministic per key: {drop_det}")
+    assert not np.allclose(np.asarray(d1, np.float32),
+                           np.asarray(f_out, np.float32)), (
+        "flash_selfcheck: dropout_rate=0.5 did not change the output "
+        "(in-kernel dropout is not being applied)")
+
     return {"flash_check": "ok", "flash_max_rel_err": round(max_rel, 5),
+            "flash_seg_rel_err": round(seg_err, 5),
             "flash_platform": jax.devices()[0].platform}
 
 

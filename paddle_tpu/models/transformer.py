@@ -44,17 +44,23 @@ def sinusoid_position_encoding(maxlen: int, dim: int) -> jnp.ndarray:
                            axis=-1).astype(jnp.float32)
 
 
-def init_kv_caches(layers, batch: int, max_len: int):
+def init_kv_caches(layers, batch: int, max_len: int, dtype=None):
     """Zeroed per-layer KV caches for incremental decode: one
-    {"k","v"} [B, max_len, H, hd] dict per layer. `layers` are modules
-    whose attention child exposes num_heads/head_dim (DecoderLayer
+    {"k","v"} [B, max_len, Hkv, hd] dict per layer. `layers` are modules
+    whose attention child exposes num_kv_heads/head_dim (DecoderLayer
     .self_attn, CausalBlock .attn). Shared by Transformer.init_cache
-    and CausalLM.init_cache so the cache layout has one definition."""
+    and CausalLM.init_cache so the cache layout has one definition.
+
+    Cache dtype follows the model's compute dtype (bf16 models decode
+    from bf16 caches — fp32 caches doubled decode's HBM bill, and decode
+    IS a cache-bandwidth workload). Softmax still runs f32 via the
+    logits promotion in kernels/attention.py. Pass dtype to override."""
     first = layers[0]
     attn = getattr(first, "self_attn", None) or first.attn
-    h, hd = attn.num_heads, attn.head_dim
-    return [{"k": jnp.zeros((batch, max_len, h, hd), jnp.float32),
-             "v": jnp.zeros((batch, max_len, h, hd), jnp.float32)}
+    h, hd = attn.num_kv_heads, attn.head_dim
+    dt = dtype if dtype is not None else attn.dtype
+    return [{"k": jnp.zeros((batch, max_len, h, hd), dt),
+             "v": jnp.zeros((batch, max_len, h, hd), dt)}
             for _ in layers]
 
 
@@ -73,21 +79,37 @@ class MultiHeadAttention(Module):
     unfused."""
 
     def __init__(self, model_dim: int, num_heads: int, dropout: float = 0.1,
-                 dtype=jnp.float32, fused_qkv: bool = False):
+                 dtype=jnp.float32, fused_qkv: bool = False,
+                 num_kv_heads: Optional[int] = None):
+        """num_kv_heads < num_heads = grouped-query attention (GQA;
+        num_kv_heads=1 = MQA): k/v project to fewer heads, shrinking the
+        decode KV cache (and its per-token HBM read) by
+        num_heads/num_kv_heads. Under tp, k_proj/v_proj column-shard —
+        requires num_kv_heads*head_dim % tp == 0. Not combinable with
+        fused_qkv (the packed [q|k|v] head-major layout assumes equal
+        head counts)."""
         super().__init__()
         assert model_dim % num_heads == 0
         self.model_dim = model_dim
         self.num_heads = num_heads
         self.head_dim = model_dim // num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0, (
+            f"num_heads {num_heads} not a multiple of num_kv_heads "
+            f"{self.num_kv_heads}")
         self.fused_qkv = fused_qkv
+        kv_dim = self.num_kv_heads * self.head_dim
         if fused_qkv:
+            assert self.num_kv_heads == num_heads, (
+                "fused_qkv packs equal-width q/k/v; use unfused "
+                "projections with num_kv_heads")
             self.qkv = Linear(3 * model_dim, dtype=dtype)
             self.q_proj = Linear(model_dim, dtype=dtype)   # cross-attn q
             self.kv = Linear(2 * model_dim, dtype=dtype)   # cross-attn kv
         else:
             self.q_proj = Linear(model_dim, dtype=dtype)
-            self.k_proj = Linear(model_dim, dtype=dtype)
-            self.v_proj = Linear(model_dim, dtype=dtype)
+            self.k_proj = Linear(kv_dim, dtype=dtype)
+            self.v_proj = Linear(kv_dim, dtype=dtype)
         self.out_proj = Linear(model_dim, dtype=dtype)
         self.drop = Dropout(dropout)
         self.dtype = dtype
@@ -95,6 +117,10 @@ class MultiHeadAttention(Module):
     def _split(self, x):
         b, t, _ = x.shape
         return x.reshape(b, t, self.num_heads, self.head_dim)
+
+    def _split_kv(self, x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, self.num_kv_heads, self.head_dim)
 
     def forward(self, cx: Context, q, kv=None, mask=None, causal=False,
                 cache: Optional[Dict] = None, decode_pos=None,
@@ -128,8 +154,8 @@ class MultiHeadAttention(Module):
             kh, vh = x[..., 0, :], x[..., 1, :]
         else:
             qh = self._split(self.q_proj(cx, q))
-            kh = self._split(self.k_proj(cx, kv_in))
-            vh = self._split(self.v_proj(cx, kv_in))
+            kh = self._split_kv(self.k_proj(cx, kv_in))
+            vh = self._split_kv(self.v_proj(cx, kv_in))
 
         if cache is not None:
             # incremental decode: write this step's k/v at decode_pos
@@ -312,10 +338,11 @@ class CausalBlock(Module):
     no cross-attention, the GPT layer shape)."""
 
     def __init__(self, model_dim, num_heads, ffn_dim, dropout=0.1,
-                 dtype=jnp.float32, fused_qkv=False):
+                 dtype=jnp.float32, fused_qkv=False, num_kv_heads=None):
         super().__init__()
         self.attn = MultiHeadAttention(model_dim, num_heads, dropout, dtype,
-                                       fused_qkv=fused_qkv)
+                                       fused_qkv=fused_qkv,
+                                       num_kv_heads=num_kv_heads)
         self.ffn = FeedForward(model_dim, ffn_dim, dropout, dtype)
         self.ln1 = LayerNorm()
         self.ln2 = LayerNorm()
@@ -354,7 +381,8 @@ class CausalLM(Module):
                  num_heads: int = 8, num_layers: int = 6,
                  ffn_dim: int = 2048, dropout: float = 0.1,
                  max_len: int = 2048, tie_embeddings: bool = True,
-                 dtype=jnp.float32, fused_qkv: bool = False):
+                 dtype=jnp.float32, fused_qkv: bool = False,
+                 num_kv_heads: Optional[int] = None):
         super().__init__()
         self.model_dim = model_dim
         self.max_len = max_len
@@ -363,7 +391,8 @@ class CausalLM(Module):
         self.dtype = dtype
         self.embed = Embedding(vocab, model_dim, dtype=dtype)
         self.blocks = [CausalBlock(model_dim, num_heads, ffn_dim, dropout,
-                                   dtype, fused_qkv)
+                                   dtype, fused_qkv,
+                                   num_kv_heads=num_kv_heads)
                        for _ in range(num_layers)]
         self.ln_f = LayerNorm()
         if not tie_embeddings:
@@ -558,11 +587,15 @@ class BertEncoder(Module):
         hidden = self.ln(cx, x)
         if mask_positions is None:
             return hidden
-        # Pre-scoping-fix checkpoints carry a rogue root-level "weight"
-        # (Embedding.attend once resolved in the PARENT scope, so the
+        # Pre-scoping-fix checkpoints carry a rogue "weight" param at THIS
+        # module's scope (Embedding.attend once resolved in the PARENT
+        # scope of embed — i.e. BertEncoder's own scope, the variables
+        # root only when BertEncoder is the top-level module — so the
         # "tied" head trained an independent matrix). Silently ignoring
         # it would change this model's MLM logits — fail loudly instead.
-        if "weight" in cx._core.variables.get(PARAMS, {}):
+        from paddle_tpu.core.module import _tree_get
+        if _tree_get(cx._core.variables.get(PARAMS, {}),
+                     cx.path + ("weight",)) is not None:
             from paddle_tpu.core.module import ModuleError
             raise ModuleError(
                 "checkpoint has a root-level 'weight' param: it predates "

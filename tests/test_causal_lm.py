@@ -162,3 +162,47 @@ def test_packed_segments_match_separate_docs():
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(out[:, n1:]), np.asarray(out2),
                                rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_gqa_decode_matches_parallel(kv_heads):
+    """GQA/MQA: KV-cache incremental decode reproduces the parallel
+    forward with num_kv_heads < num_heads, and the cache stores only the
+    kv heads in the model's compute dtype."""
+    from paddle_tpu.core.module import Context, _CtxCore
+
+    model, variables, tok = _model_and_tokens(seed=5,
+                                              num_kv_heads=kv_heads)
+    full = model.apply(variables, tok)
+    cx = Context(_CtxCore(mode="apply", variables=variables, mutated={},
+                          rng=None, rng_count=0, training=False))
+    caches = model.init_cache(tok.shape[0], max_len=tok.shape[1])
+    assert caches[0]["k"].shape[2] == kv_heads
+    assert caches[0]["k"].dtype == model.dtype  # follows compute dtype
+    outs = []
+    for i in range(tok.shape[1]):
+        logits, caches = model.decode_step(cx, tok[:, i], i, caches)
+        outs.append(logits)
+    inc = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(inc),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_generate_and_prefill():
+    model, variables, tok = _model_and_tokens(seed=6, num_kv_heads=1)
+    out = model.generate(variables, tok[:, :4], num_steps=5)
+    assert out.shape == (tok.shape[0], 9)
+    cur = tok[:, :4]
+    for _ in range(5):
+        logits = model.apply(variables, cur)[:, -1]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(cur))
+
+
+def test_bf16_model_decodes_from_bf16_cache():
+    model, variables, tok = _model_and_tokens(seed=7, dtype=jnp.bfloat16)
+    caches = model.init_cache(tok.shape[0], max_len=tok.shape[1])
+    assert caches[0]["k"].dtype == jnp.bfloat16
+    out = model.generate(variables, tok[:, :4], num_steps=3)
+    assert out.shape == (tok.shape[0], 7)

@@ -127,3 +127,18 @@ def test_mha_kv_len_reference_path(rng):
     mask = (jnp.arange(8) < 5)[None, None, None, :]
     ref = reference_attention(q, q, q, mask=mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5)
+
+
+def test_reference_attention_gqa_matches_repeat(rng):
+    """Grouped-query dense path == plain path with kv heads repeated."""
+    b, t, h, kvh, d = 2, 24, 4, 2, 16
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, kvh, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, kvh, d).astype(np.float32))
+    mask = (jnp.arange(t)[None, :] <= jnp.arange(t)[:, None])[None, None]
+    out = reference_attention(q, k, v, mask=mask)
+    kr = jnp.repeat(k, h // kvh, axis=2)
+    vr = jnp.repeat(v, h // kvh, axis=2)
+    ref = reference_attention(q, kr, vr, mask=mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
