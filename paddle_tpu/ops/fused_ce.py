@@ -66,6 +66,22 @@ def mfu_flops_correction(n_tokens: int, dim: int, vocab: int,
     return float(n_tokens) * dim * (6.0 * vocab - 8.0 * c)
 
 
+def _vma_up(x, *refs):
+    """Inside a check_vma=True shard_map region (pipeline_stream_1f1b),
+    scan carries must enter with the varying-axes type the body
+    produces; pcast the invariant init zeros up to the union of the
+    data operands' vma. A no-op everywhere else (empty vma)."""
+    try:
+        have = jax.typeof(x).vma
+        need = frozenset().union(
+            *[jax.typeof(r).vma for r in refs if r is not None]) - have
+    except Exception:  # older jax: no vma tracking
+        return x
+    if not need:
+        return x
+    return lax.pcast(x, tuple(sorted(need)), to="varying")
+
+
 def _chunk_logits(h, w, b, i, chunk):
     """f32 logits for vocab chunk i: [N, chunk], padded cols forced to
     -inf. w is pre-padded to a chunk multiple by the wrapper."""
@@ -118,9 +134,10 @@ def _lce_fwd(h, w, b, labels, chunk, ignore_index):
         tgt = jnp.where(hit, picked, tgt)
         return (nm, s, tgt), None
 
-    init = (jnp.full((n,), _NEG, jnp.float32),
-            jnp.zeros((n,), jnp.float32),
-            jnp.zeros((n,), jnp.float32))
+    init = tuple(_vma_up(x, h, w, b, labels) for x in (
+        jnp.full((n,), _NEG, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32)))
     (m, s, tgt), _ = lax.scan(body, init,
                               jnp.arange(_num_chunks(v, chunk)))
     lse = m + jnp.log(s)
@@ -153,8 +170,9 @@ def _lce_bwd(chunk, ignore_index, res, g):
         dw = lax.dynamic_update_slice_in_dim(dw, dwc, i * chunk, axis=1)
         return (dh, dw), jnp.sum(dl.astype(jnp.float32), axis=0)
 
-    init = (jnp.zeros(h.shape, jnp.float32),
-            jnp.zeros((h.shape[1], v_pad), jnp.float32))
+    init = tuple(_vma_up(x, h, w, b, g, safe) for x in (
+        jnp.zeros(h.shape, jnp.float32),
+        jnp.zeros((h.shape[1], v_pad), jnp.float32)))
     (dh, dw), dbs = lax.scan(body, init,
                              jnp.arange(_num_chunks(v, chunk)))
     db = None if b is None else dbs.reshape(-1)[:v].astype(b.dtype)

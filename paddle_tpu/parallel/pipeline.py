@@ -263,6 +263,274 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
     return fn
 
 
+def pipeline_stream_1f1b(stage_fn: Callable,
+                         consume_fn: Callable,
+                         mesh: Mesh, axis: str = "pp",
+                         batch_axes: Sequence[str] = (),
+                         param_specs: Optional[Pytree] = None):
+    """1F1B-scheduled variant of `pipeline_stream`: same contract
+    (fn(stacked_params, aux_params, xs, ys) -> mean scalar loss, same
+    value), different activation-memory shape.
+
+    GPipe here is jax.grad THROUGH the conveyor scan: autodiff stores
+    every tick's stage residuals, so per-device activation liveness
+    grows O(M) with the microbatch count — the reason 1F1B exists at
+    scale. This schedule interleaves the backward into the SAME scan:
+
+    - forward: stage s runs microbatch j at tick t = j + s (the conveyor
+      unchanged — strided injection, ppermute hops);
+    - the last stage consumes microbatch j the tick it finishes
+      (t = j + S - 1) and immediately seeds its cotangent (1F, then 1B —
+      the classic last-stage alternation);
+    - backward: stage s runs the VJP for microbatch j at tick
+      t = j + 2(S-1) - s; cotangents hop stage s+1 -> s via the reverse
+      ppermute; parameter grads accumulate in-carry.
+
+    Each stage keeps only a ring stash of its in-flight microbatch
+    INPUTS (depth 2S-1 — the widest span, at stage 0) and recomputes the
+    stage forward inside its backward tick via jax.vjp (the remat
+    convention: recompute is cheaper than liveness). Peak activation
+    state is therefore O(S·act) per device, independent of M, at the
+    cost of one extra stage-forward per backward tick and S-1 extra
+    drain ticks (total M + 2(S-1) vs M + S - 1): memory, not bubble, is
+    what 1F1B buys — measured numbers in PERF_NOTES.
+
+    The whole combined scan runs inside a custom_vjp FORWARD rule that
+    returns (loss, grads): the backward rule just scales the
+    precomputed grads by the incoming cotangent, so jax.grad of this
+    loss never differentiates through the scan (no residual stashing)
+    and MeshTrainer's value_and_grad plugs in unchanged.
+
+    Supports tp-sharded stage weights and stage-aux scalars (MoE load
+    balance). This shard_map runs with check_vma=True — unlike the
+    GPipe path, the backward here calls jax.vjp INSIDE the manual
+    region, and only the vma (varying-manual-axes) machinery transposes
+    the stage's tp psums exactly (with check_vma=False, psum transposes
+    to psum and a replicated cotangent gets multiplied by the axis
+    size — measured, not theoretical). `seq_axes` (ring/ulysses inside
+    stages) is a GPipe-only feature for now.
+    """
+    baxes = tuple(batch_axes)
+    ndp = 1
+    for a in baxes:
+        ndp *= mesh.shape[a]
+
+    def _combined(stacked_params, aux_params, xs, ys):
+        s = mesh.shape[axis]
+        v = _check_stages(stacked_params, s, axis)
+        xs_str, m = _strided(xs, s)
+        ys_str, _ = _strided(ys, s)
+        total = m + 2 * (s - 1)
+        ring = max(2 * s - 1, 1)
+        fwd_perm = [(i, (i + 1) % s) for i in range(s)]
+        rev_perm = [(i, (i - 1) % s) for i in range(s)]
+
+        def local(params, aux, xs_l, ys_l):
+            xs_l = xs_l[:, 0]
+            ys_l = ys_l[:, 0]
+            stage = lax.axis_index(axis)
+            zero = jnp.zeros_like(xs_l[0])
+
+            # the stage-aux scalar's varying-axes type depends on the
+            # stage_fn (a constant zero for plain blocks, data-derived
+            # for MoE); multiply by a canonically-varying one so the
+            # masked cotangent below always typechecks against it
+            vone = lax.pcast(jnp.float32(1.0), (axis,) + baxes,
+                             to="varying")
+
+            def vup(x):
+                have = getattr(jax.typeof(x), "vma", frozenset())
+                need = tuple(a for a in (axis,) + baxes if a not in have)
+                return lax.pcast(x, need, to="varying") if need else x
+
+            # pcast params/aux UP to (pp,)+baxes-varying ONCE, before the
+            # scan: the in-tick jax.vjp then returns LOCAL cotangents of
+            # matching vma type for every leaf — including through
+            # custom_vjp ops (fused CE), whose user-written bwd cannot
+            # satisfy the vma typecheck against an invariant primal (the
+            # driver's clean env enforces that check;
+            # jax_disable_bwd_checks=True environments merely hid it).
+            # Keeping cotangents local also avoids a per-tick psum of
+            # head-sized grads; `_complete` below psums once, post-scan.
+            params = jax.tree.map(vup, params)
+            aux = jax.tree.map(vup, aux)
+
+            def chain(p, x):
+                y, aux_s = _chain_stages(stage_fn, p, x)
+                return y, aux_s * vone
+
+            def consume_grads(y, tgt, cot):
+                li, cvjp = jax.vjp(
+                    lambda a, yy: consume_fn(a, yy, tgt), aux, y)
+                da_t, dy = cvjp(cot.astype(li.dtype))
+                return li, da_t, dy
+
+            # Probe ONE tick's cotangent computation before the scan to
+            # get correctly-TYPED zero accumulators: under check_vma=True
+            # the in-region jax.vjp auto-psums cotangents of invariant
+            # inputs (they come back invariant AND complete), EXCEPT
+            # through custom_vjp ops (e.g. the fused-CE head grad),
+            # whose user-written bwd returns local varying values. The
+            # per-leaf vma therefore depends on consume_fn/stage_fn
+            # internals; the probe inherits it exactly, and `_complete`
+            # below psums precisely the leaves that came back local.
+            x0 = jnp.where(stage == 0, lax.psum(
+                jnp.where(stage == 0, xs_l[0], zero), axis), zero)
+            tgt0 = lax.psum(
+                jnp.where(stage == 0, ys_l[0],
+                          jnp.zeros_like(ys_l[0])), axis)
+            # zero-valued, but with the body cotangents' exact vma type:
+            # pp-varying (stage masks) + baxes-varying (pcast)
+            cot0 = lax.pcast(jnp.where(stage == s - 1, 0.0, 0.0),
+                             baxes, to="varying")
+            y0, _ = chain(params, x0)
+            _, da0, _ = consume_grads(y0, tgt0, cot0)
+            _, chain_vjp0 = jax.vjp(chain, params, x0)
+            dp0, _ = chain_vjp0((jnp.zeros_like(y0), cot0))
+            zeros_typed = lambda tree: jax.tree.map(
+                lambda g: g * jnp.zeros((), g.dtype), tree)
+
+            def tick(carry, t):
+                (fwd_buf, bwd_buf, stash, dp_acc, da_acc, dxs_acc,
+                 acc, sacc) = carry
+
+                # ---- forward conveyor (identical to pipeline_stream) --
+                cand = xs_l[jnp.minimum(t, m - 1) // s]
+                x_in = lax.psum(
+                    jnp.where((stage == t % s) & (t < m), cand, zero),
+                    axis)
+                x_t = jnp.where(stage == 0, x_in, fwd_buf)
+                j_f = t - stage
+                fwd_valid = (stage <= t) & (t < stage + m)
+                slot_f = jnp.clip(j_f, 0, m - 1) % ring
+                stash = stash.at[slot_f].set(
+                    jnp.where(fwd_valid, x_t, stash[slot_f]))
+                y, stage_aux = chain(params, x_t)
+                sacc = sacc + jnp.where(fwd_valid, stage_aux, 0.0)
+
+                # ---- last stage: loss value + cotangent seed ----------
+                j = t - (s - 1)
+                jc = jnp.clip(j, 0, m - 1)
+                t_cand = ys_l[jc // s]
+                tgt = lax.psum(
+                    jnp.where((stage == jc % s) & (j >= 0), t_cand,
+                              jnp.zeros_like(t_cand)), axis)
+                # unlike the gpipe scan, this one runs s-1 extra drain
+                # ticks where j walks past the last microbatch: mask the
+                # upper bound too or the final microbatch double-counts
+                last_valid = (stage == s - 1) & (j >= 0) & (j < m)
+                # d(total loss)/d(this consume) = 1/(m·ndp): the psum/m
+                # over pp and the pmean over dp. pcast aligns the
+                # cotangent's varying-axes type with li's (it is built
+                # from pp-varying masks only; li also varies over dp)
+                cot = jnp.where(last_valid, 1.0 / (m * ndp), 0.0)
+                cot = lax.pcast(cot, baxes, to="varying")
+                li, da_t, dy_loss = consume_grads(y, tgt, cot)
+                acc = acc + jnp.where(last_valid,
+                                      li.astype(jnp.float32), 0.0)
+                da_acc = jax.tree.map(lambda a_, d: a_ + d, da_acc, da_t)
+
+                # ---- backward conveyor --------------------------------
+                j_b = t - 2 * (s - 1) + stage
+                bwd_valid = (j_b >= 0) & (j_b < m)
+                g_in = jnp.where(stage == s - 1, dy_loss, bwd_buf)
+                x_saved = stash[jnp.clip(j_b, 0, m - 1) % ring]
+                _, chain_vjp = jax.vjp(chain, params, x_saved)
+                # stage-aux cotangent: the psum(sacc)/(s·v·m) loss term,
+                # pmean'd over dp
+                aux_cot = jnp.where(bwd_valid,
+                                    1.0 / (s * v * m * ndp), 0.0)
+                aux_cot = lax.pcast(aux_cot.astype(jnp.float32),
+                                    baxes, to="varying")
+                dp_t, dx_t = chain_vjp((g_in, aux_cot))
+                dp_acc = jax.tree.map(lambda a_, d: a_ + d, dp_acc, dp_t)
+
+                # input grads pop out of stage 0 -> their strided owner
+                j0 = t - 2 * (s - 1)
+                j0c = jnp.clip(j0, 0, m - 1)
+                dx_out = lax.psum(
+                    jnp.where((stage == 0) & (j0 >= 0), dx_t,
+                              jnp.zeros_like(dx_t)), axis)
+                own = (stage == j0c % s) & (j0 >= 0)
+                dxs_acc = dxs_acc.at[j0c // s].set(
+                    jnp.where(own, dx_out, dxs_acc[j0c // s]))
+
+                fwd_next = lax.ppermute(y, axis, fwd_perm)
+                bwd_next = lax.ppermute(
+                    jnp.where(bwd_valid, dx_t, jnp.zeros_like(dx_t)),
+                    axis, rev_perm)
+                return (fwd_next, bwd_next, stash, dp_acc, da_acc,
+                        dxs_acc, acc, sacc), None
+
+            # scan carries must enter with the vma type the body
+            # produces: the accumulators start as invariant zeros but
+            # become (pp, dp)-varying inside — pcast the inits up
+            init = (zero, zero,
+                    vup(jnp.zeros((ring,) + zero.shape, zero.dtype)),
+                    zeros_typed(dp0),
+                    zeros_typed(da0),
+                    jnp.zeros_like(xs_l),
+                    vup(jnp.zeros((), jnp.float32)),
+                    vup(jnp.zeros((), jnp.float32)))
+            (_, _, _, dp_acc, da_acc, dxs_acc, acc, sacc), _ = lax.scan(
+                tick, init, jnp.arange(total))
+            loss = lax.psum(acc, axis) / m
+            loss = loss + lax.psum(sacc, axis) / (s * v * m)
+            if baxes:
+                loss = lax.pmean(loss, baxes)
+
+            # Complete the grads: leaves whose cotangents came back
+            # invariant were ALREADY auto-psum'd by the vma transpose
+            # (psum'ing again double-counts — measured); leaves still
+            # varying over an axis their param is replicated on (the
+            # custom_vjp escape hatch above) hold local contributions
+            # and need exactly one psum over those axes. Stage params
+            # are pp-sharded by design, so pp is never completed there.
+            def _complete(allowed):
+                def go(g):
+                    vma = getattr(jax.typeof(g), "vma", frozenset())
+                    ax = tuple(a for a in allowed if a in vma)
+                    return lax.psum(g, ax) if ax else g
+                return go
+
+            dp_acc = jax.tree.map(_complete(baxes), dp_acc)
+            da_acc = jax.tree.map(_complete((axis,) + baxes), da_acc)
+            return loss, dp_acc, da_acc, dxs_acc[:, None]
+
+        def data_spec(arr):
+            entries = (None, axis, baxes if baxes else None)
+            return P(*entries[:min(arr.ndim, 3)])
+
+        xs_spec = data_spec(xs_str)
+        pspec = param_specs if param_specs is not None else P(axis)
+        loss, dp, da, dxs_str = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(pspec, P(), xs_spec, data_spec(ys_str)),
+            out_specs=(P(), pspec, P(), xs_spec),
+            check_vma=True)(stacked_params, aux_params, xs_str, ys_str)
+        # un-stride the input grads back to the [M, ...] layout of xs
+        mp = dxs_str.shape[0] * dxs_str.shape[1]
+        dxs = dxs_str.reshape((mp,) + dxs_str.shape[2:])[:xs.shape[0]]
+        return loss, dp, da, dxs
+
+    @jax.custom_vjp
+    def stream(stacked_params, aux_params, xs, ys):
+        return _combined(stacked_params, aux_params, xs, ys)[0]
+
+    def stream_fwd(stacked_params, aux_params, xs, ys):
+        loss, dp, da, dxs = _combined(stacked_params, aux_params, xs, ys)
+        return loss, (dp, da, dxs)
+
+    def stream_bwd(res, g):
+        dp, da, dxs = res
+        scale = lambda x: (x * g).astype(x.dtype)
+        return (jax.tree.map(scale, dp), jax.tree.map(scale, da),
+                scale(dxs), None)
+
+    stream.defvjp(stream_fwd, stream_bwd)
+    return stream
+
+
 def pipeline_loss_fn(stage_fn: Callable, loss_of_outputs: Callable,
                      mesh: Mesh, axis: str = "pp",
                      num_microbatches: Optional[int] = None):
@@ -656,7 +924,8 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
                       tp_axis: Optional[str] = None,
                       sp_axis: Optional[str] = None,
                       sp_mode: str = "ring",
-                      fused_ce: bool = False):
+                      fused_ce: bool = False,
+                      schedule: str = "gpipe"):
     """MeshTrainer loss_fn training PipelinedLM through the pipeline.
 
     batch = (tokens_in [B, T], tokens_out [B, T]); num_microbatches
@@ -677,7 +946,15 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     over vocab chunks), shrinking the last stage's peak activation from
     O(tokens·V) to O(tokens·chunk) — the knob for long sequences or
     large vocabularies; exact same loss (parity-tested).
+
+    `schedule`: "gpipe" (jax.grad through the conveyor — activation
+    residuals O(M)) or "1f1b" (`pipeline_stream_1f1b` — in-scan
+    interleaved backward, O(S) activation stash; same loss and grads,
+    parity-tested). 1f1b composes with tp but not (yet) sp.
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                         f"got {schedule!r}")
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
     tp = tp_axis if tp_axis is not None and mesh.shape.get(tp_axis, 1) > 1 \
         else None
@@ -687,6 +964,9 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
     if sp_mode not in SP_MODES:
         raise ValueError(f"sp_mode must be one of {SP_MODES}, "
                          f"got {sp_mode!r}")
+    if schedule == "1f1b" and sp is not None:
+        raise ValueError("schedule='1f1b' does not compose with sp yet; "
+                         "use the gpipe schedule for sequence parallelism")
 
     def loss_fn(module, variables, batch, rng, training):
         tok_in, tok_out = batch
@@ -717,12 +997,18 @@ def pipelined_lm_loss(mesh: Mesh, axis: str = "pp",
         xs = _microbatch(h, m)
         ys = _microbatch(tok_out, m)
 
-        stream = pipeline_stream(
-            partial(lm_block, n_heads=module.n_heads, tp_axis=tp,
-                    sp_axis=sp, sp_size=sp_size, sp_mode=sp_mode),
-            _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
-            param_specs=_stage_specs(axis, tp) if tp else None,
-            seq_axes=(sp,) if sp else ())
+        if schedule == "1f1b":
+            stream = pipeline_stream_1f1b(
+                partial(lm_block, n_heads=module.n_heads, tp_axis=tp),
+                _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
+                param_specs=_stage_specs(axis, tp) if tp else None)
+        else:
+            stream = pipeline_stream(
+                partial(lm_block, n_heads=module.n_heads, tp_axis=tp,
+                        sp_axis=sp, sp_size=sp_size, sp_mode=sp_mode),
+                _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
+                param_specs=_stage_specs(axis, tp) if tp else None,
+                seq_axes=(sp,) if sp else ())
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),
                       xs, ys)
         return (loss, {}), {}

@@ -16,6 +16,11 @@ if not os.environ.get("PTPU_TEST_REAL_DEVICE"):
             flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # The axon sitecustomize sets jax_disable_bwd_checks=True, which
+    # HIDES custom_vjp bwd type errors (vma mismatches) that the
+    # driver's clean subprocess enforces — run the suite strict.
+    if "jax_disable_bwd_checks" in jax.config.values:
+        jax.config.update("jax_disable_bwd_checks", False)
 
 import numpy as np
 import pytest
