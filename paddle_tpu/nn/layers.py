@@ -30,6 +30,18 @@ def _pair(v) -> Tuple[int, int]:
     return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
 
 
+def normalize_padding(pad):
+    """2-D conv padding in its lax form: "SAME"/"VALID" pass through; an
+    int or (h, w) int pair becomes explicit per-dim (lo, hi) pairs. One
+    home for the idiom (Conv2D and the quant/int8 conv twins all accept
+    the same forms)."""
+    if isinstance(pad, int):
+        return [(pad, pad), (pad, pad)]
+    if isinstance(pad, (tuple, list)) and isinstance(pad[0], int):
+        return [(pad[0], pad[0]), (pad[1], pad[1])]
+    return pad
+
+
 class Linear(Module):
     """Fully-connected layer (reference fluid.layers.fc, nn.py; mul+add ops).
 
@@ -95,11 +107,7 @@ class Conv2D(Module):
         w = cx.param("weight", (kh, kw, cin // self.groups, self.features),
                      self.kernel_init, self.param_dtype)
         x, w = self._qtransform(cx, x, w)
-        pad = self.padding
-        if isinstance(pad, int):
-            pad = [(pad, pad), (pad, pad)]
-        elif isinstance(pad, (tuple, list)) and isinstance(pad[0], int):
-            pad = [(pad[0], pad[0]), (pad[1], pad[1])]
+        pad = normalize_padding(self.padding)
         y = lax.conv_general_dilated(
             x.astype(self.dtype), w.astype(self.dtype),
             window_strides=self.stride, padding=pad,

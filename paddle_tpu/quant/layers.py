@@ -20,7 +20,8 @@ from jax import lax
 
 from paddle_tpu.core.module import Context, Module
 from paddle_tpu.nn import initializers as I
-from paddle_tpu.nn.layers import Conv2D, Linear
+from paddle_tpu.nn.layers import (Conv2D, Linear,
+                                  normalize_padding)
 from paddle_tpu.quant.fake_quant import (
     fake_quant_channel_abs_max, fake_quant_moving_average)
 
@@ -102,11 +103,7 @@ class QuantConv2D(Conv2D):
             cx.set_state("act_scale", new_scale)
         wq, _ = fake_quant_channel_abs_max(w.astype(jnp.float32),
                                            self.weight_bits, axis=-1)
-        pad = self.padding
-        if isinstance(pad, int):
-            pad = [(pad, pad), (pad, pad)]
-        elif isinstance(pad, (tuple, list)) and isinstance(pad[0], int):
-            pad = [(pad[0], pad[0]), (pad[1], pad[1])]
+        pad = normalize_padding(self.padding)
         y = lax.conv_general_dilated(
             xq.astype(self.dtype), wq.astype(self.dtype),
             window_strides=self.stride, padding=pad,
@@ -128,18 +125,28 @@ def _convert(m: Module, weight_bits: int, act_bits: int) -> Module:
     return m
 
 
+def swap_layers(module: Module, convert) -> Module:
+    """In-place module-tree rewrite: `convert(m) -> m'` is applied to
+    every child Module (attributes and Module lists/tuples); converters
+    recurse into containers themselves. The single walker behind
+    quantize_model AND quant.int8_compute.int8_compute_model — the two
+    rewrites are one traversal with different leaf maps."""
+    for attr, value in list(vars(module).items()):
+        if attr in ("_children", "_name"):
+            continue
+        if isinstance(value, Module):
+            setattr(module, attr, convert(value))
+        elif isinstance(value, (list, tuple)) and value and all(
+                isinstance(v, Module) for v in value):
+            newl = [convert(v) for v in value]
+            setattr(module, attr, type(value)(newl))
+    return module
+
+
 def quantize_model(module: Module, weight_bits: int = 8,
                    act_bits: int = 8) -> Module:
     """In-place QAT rewrite of a module tree (QuantizationTransformPass
     capability): every Linear/Conv2D becomes its Quant* twin; other
     modules are recursed into. Returns the same (mutated) module."""
-    for attr, value in list(vars(module).items()):
-        if attr in ("_children", "_name"):
-            continue
-        if isinstance(value, Module):
-            setattr(module, attr, _convert(value, weight_bits, act_bits))
-        elif isinstance(value, (list, tuple)) and value and all(
-                isinstance(v, Module) for v in value):
-            newl = [_convert(v, weight_bits, act_bits) for v in value]
-            setattr(module, attr, type(value)(newl))
-    return module
+    return swap_layers(module,
+                       lambda m: _convert(m, weight_bits, act_bits))

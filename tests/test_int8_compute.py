@@ -1,0 +1,110 @@
+"""True-int8 inference path (quant/int8_compute.py): scheme exactness,
+model-level accuracy, calibrated static scales, and the freeze flow.
+
+On TPU the int8 convs/matmuls run on the MXU at ~1.3-1.7x bf16
+(PERF_NOTES round 5; bench int8_compute rows); on CPU these tests pin
+the NUMERICS the speed relies on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.module import PARAMS, STATE
+from paddle_tpu.models import vision as V
+from paddle_tpu.nn.layers import Conv2D, Linear
+from paddle_tpu.quant.int8_compute import (Int8Conv2D, Int8Linear, QMAX,
+                                           freeze_int8)
+
+
+def test_linear_scheme_exactness(rng):
+    """Int8Linear == the symmetric per-channel dequant formula applied
+    by hand: y = (xq @ wq) * xs * ws / 127^2 + b."""
+    lin = Linear(8)
+    x = jnp.asarray(rng.randn(4, 6).astype(np.float32))
+    variables = lin.init(jax.random.key(0), x)
+    qlin, qvars = freeze_int8(lin, variables)
+    assert isinstance(qlin, Int8Linear)
+    got = qlin.apply(qvars, x)
+
+    w = np.asarray(variables[PARAMS]["weight"])
+    b = np.asarray(variables[PARAMS]["bias"])
+    ws = np.maximum(np.abs(w).max(axis=0), 1e-12)
+    wq = np.clip(np.round(w / ws * QMAX), -QMAX, QMAX)
+    xs = max(np.abs(np.asarray(x)).max(), 1e-12)
+    xq = np.clip(np.round(np.asarray(x) / xs * QMAX), -QMAX, QMAX)
+    want = (xq @ wq) * xs * ws / (QMAX * QMAX) + b
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_freeze_stores_int8_weights(rng):
+    model = V.ResNet((1, 1, 1, 1), 10)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+    variables = model.init(jax.random.key(0), x)
+    qmodel, qvars = freeze_int8(model, variables)
+    flat = jax.tree_util.tree_flatten_with_path(qvars[PARAMS])[0]
+    n8 = [p for p, l in flat if l.dtype == jnp.int8]
+    scales = [p for p, _ in flat
+              if any(getattr(k, "key", k) == "w_scale" for k in p)]
+    assert len(n8) >= 10 and len(n8) == len(scales)
+
+
+def test_model_accuracy_close_to_float(rng):
+    model = V.ResNet((1, 1, 1, 1), 10)
+    x = jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32))
+    variables = model.init(jax.random.key(0), x)
+    ref = np.asarray(model.apply(variables, x, training=False))
+    qmodel, qvars = freeze_int8(model, variables)
+    out = np.asarray(qmodel.apply(qvars, x, training=False))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.1, rel
+    assert (out.argmax(-1) == ref.argmax(-1)).mean() >= 0.75
+
+
+def test_calibrated_static_scales(rng):
+    """Calibration collects per-layer EMA act scales; the frozen model
+    then quantizes with the STATIC scales (elementwise, fusable) and
+    stays accurate on in-distribution inputs."""
+    model = V.ResNet((1, 1, 1, 1), 10)
+    x = jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32))
+    variables = model.init(jax.random.key(0), x)
+    ref = np.asarray(model.apply(variables, x, training=False))
+
+    calib = [jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32))
+             for _ in range(3)]
+    qmodel, qvars = freeze_int8(model, variables, calib_batches=calib)
+    # act_scale state materialized and positive
+    scales = [np.asarray(l) for p, l in
+              jax.tree_util.tree_flatten_with_path(qvars[STATE])[0]
+              if any(getattr(k, "key", k) == "act_scale" for k in p)]
+    assert scales and all(s > 0 for s in scales)
+    out = np.asarray(qmodel.apply(qvars, x, training=False))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.15, rel
+    assert (out.argmax(-1) == ref.argmax(-1)).mean() >= 0.75
+
+
+def test_empty_calibration_rejected(rng):
+    lin = Linear(4)
+    x = jnp.ones((2, 3))
+    variables = lin.init(jax.random.key(0), x)
+    with pytest.raises(ValueError, match="empty calib_batches"):
+        freeze_int8(lin, variables, calib_batches=[])
+
+
+def test_lm_head_int8(rng):
+    """The untied CausalLM head (a plain Linear) freezes to int8 and the
+    model still produces close logits — the LM-head serving win
+    (measured 1.49x at [4096,512]x[512,32000] on v5e)."""
+    from paddle_tpu.models.transformer import CausalLM
+    model = CausalLM(61, model_dim=16, num_heads=2, num_layers=1,
+                     ffn_dim=32, dropout=0.0, max_len=16,
+                     tie_embeddings=False)
+    tok = jnp.asarray(rng.randint(0, 61, (2, 8)), jnp.int32)
+    variables = model.init(jax.random.key(0), tok)
+    ref = np.asarray(model.apply(variables, tok))
+    qmodel, qvars = freeze_int8(model, variables)
+    out = np.asarray(qmodel.apply(qvars, tok))
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.2, rel
