@@ -43,9 +43,12 @@ def _budget_ok(est_s: float = 120.0) -> bool:
     return _elapsed() + est_s < _BUDGET_S
 
 
-def _scaling_subprocess():
-    """dp=1..8 weak-scaling on a virtual CPU mesh (own process: platform
-    choice is frozen at first jax import)."""
+def _scaling_subprocess_start():
+    """Launch the dp=1..8 weak-scaling sweep on a virtual CPU mesh as a
+    BACKGROUND subprocess (own process: platform choice is frozen at
+    first jax import; background: it shares no device with the TPU
+    entries, so running it concurrently costs the bench ~zero budget —
+    the r4 artifact budget-dropped it, r4 VERDICT missing #1)."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -68,12 +71,21 @@ def _scaling_subprocess():
         " per_chip_batch=8, min_time=0.3)\n"
         "out.update(scaling_summary(rows, prefix='bert_'))\n"
         "print('SCALING ' + json.dumps(out))\n")
-    proc = subprocess.run([sys.executable, "-c", code], cwd=here, env=env,
-                          capture_output=True, text=True, timeout=900)
-    for line in proc.stdout.splitlines():
+    return subprocess.Popen([sys.executable, "-c", code], cwd=here,
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _scaling_subprocess_join(proc, timeout: float = 900):
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return {"scaling_error": f"scaling subprocess >{timeout:.0f}s"}
+    for line in stdout.splitlines():
         if line.startswith("SCALING "):
             return json.loads(line[len("SCALING "):])
-    return {"scaling_error": (proc.stderr or proc.stdout)[-200:]}
+    return {"scaling_error": (stderr or stdout)[-200:]}
 
 
 def _longcontext_bench(seq: int = 16384):
@@ -211,10 +223,17 @@ def _moe_bench(min_time: float = 1.0):
     x = jnp.asarray(np.random.RandomState(0).randn(T, D),
                     jnp.bfloat16) * 0.3
     out = {}
+    # cf 1.0 and 2.0 bracket the capacity contract: smaller buffers are
+    # faster but drop more under skew (training behavior under pressure
+    # is tested in tests/test_moe.py::test_moe_a2a_under_capacity_pressure)
     for label, fn in (
             ("masked", lambda p, xx: moe_ffn(p, xx, k=2)[0]),
             ("a2a", lambda p, xx: moe_ffn_a2a(p, xx, mesh=mesh, k=2,
-                                              capacity_factor=1.25)[0])):
+                                              capacity_factor=1.25)[0]),
+            ("a2a_cf1", lambda p, xx: moe_ffn_a2a(p, xx, mesh=mesh, k=2,
+                                                  capacity_factor=1.0)[0]),
+            ("a2a_cf2", lambda p, xx: moe_ffn_a2a(p, xx, mesh=mesh, k=2,
+                                                  capacity_factor=2.0)[0])):
         g = jax.grad(lambda p, xx: jnp.mean(
             fn(p, xx).astype(jnp.float32) ** 2))
         K = 4
@@ -227,39 +246,274 @@ def _moe_bench(min_time: float = 1.0):
     return out
 
 
-def _decode_bench(min_time: float = 1.0):
-    """Autoregressive decode throughput: CausalLM.generate (parallel
-    prefill + KV-cached steps) at the lm_longctx model size — the
-    serving-side number next to the training tok/s (reference analog:
-    the inference latency tables, BASELINE.md infer rows)."""
+def _decode_bench(min_time: float = 0.8):
+    """Autoregressive decode: CausalLM.generate (parallel prefill +
+    bf16-KV-cached steps) at the lm_longctx model size, swept over
+    batch {1, 8, 32} at prompt 32 and prompt {2048, 8192} at bs 8 —
+    with a bytes/token HBM roofline per point (decode reads the full
+    parameter set + the KV cache every step; r4 VERDICT #4 demanded the
+    sweep, the roofline, and >=2x bs8->bs32 throughput).
+
+    Prefill is timed separately (its own jit of model.prefill) and
+    subtracted, so decode_ms_per_token is steady-state decode only
+    (r4 ADVICE: dividing the whole generate wall time by the step count
+    overstated per-token latency).
+
+    Roofline caveat (measured): hbm_bound_frac can exceed 1 at small
+    batch/prompt because the model's 70 MB of bf16 weights fit v5e VMEM
+    and XLA keeps them RESIDENT across the decode fori_loop — the
+    "params re-read every step" premise only binds once the KV cache +
+    activations push weights out (the long-prompt points, frac ~0.3-0.4,
+    are the genuinely HBM-bound regime). The frac is reported per point
+    so the regime is visible, not asserted away."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from paddle_tpu.benchmark.harness import run_timed
     from paddle_tpu.benchmark.models import LM_BASE, LM_VOCAB
+    from paddle_tpu.core.module import Context, PARAMS, _CtxCore
     from paddle_tpu.models.transformer import CausalLM
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    bs, t0, steps = (8, 32, 256) if on_tpu else (2, 8, 16)
-    model = CausalLM(LM_VOCAB, max_len=t0 + steps,
-                     dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-                     **LM_BASE)
+    HBM_GBPS = 819.0            # v5e datasheet HBM bandwidth
+    points = ([(1, 32), (8, 32), (32, 32), (8, 2048), (8, 8192)]
+              if on_tpu else [(2, 8)])
+    steps = 128 if on_tpu else 8
+    out = {}
     rs = np.random.RandomState(0)
-    tok = jnp.asarray(rs.randint(0, LM_VOCAB, (bs, t0)), jnp.int32)
-    variables = model.init(jax.random.key(0), tok)
-    gen = jax.jit(lambda v, pr: model.generate(v, pr, steps))
+    for bs, t0 in points:
+        model = CausalLM(LM_VOCAB, max_len=t0 + steps,
+                         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+                         **LM_BASE)
+        tok = jnp.asarray(rs.randint(0, LM_VOCAB, (bs, t0)), jnp.int32)
+        variables = model.init(jax.random.key(0), tok)
+        gen = jax.jit(lambda v, pr: model.generate(v, pr, steps))
 
-    def step(pr):
-        out = gen(variables, pr)
-        # loop-carry the prompt from the output so the axon pool cannot
-        # serve a cached result for a repeated identical dispatch
-        return out[:, -t0:], out
+        def prefill_fn(v, pr, model=model, t0=t0):
+            cx = Context(_CtxCore(mode="apply", variables=v, mutated={},
+                                  rng=None, rng_count=0, training=False))
+            caches = model.init_cache(pr.shape[0], t0 + steps)
+            return model.prefill(cx, pr, caches)[0]
 
-    sec, _, _ = run_timed(step, tok, min_time=min_time)
-    return {"decode_tokens_per_sec": round(bs * steps / sec, 1),
-            "decode_ms_per_token": round(sec / steps * 1e3, 3),
-            "decode_bs": bs, "decode_steps": steps}
+        pre = jax.jit(prefill_fn)
+
+        # loop-carry a PROMPT THAT NEVER REPEATS: an untrained model's
+        # greedy continuation collapses to a constant token, so feeding
+        # out[:, -t0:] back makes every dispatch after the first
+        # identical and the axon pool serves cached results (measured:
+        # bs1 "decode" at 4.8x the HBM roofline). Mixing in the previous
+        # prompt AND a step counter keeps inputs injective.
+        def step_gen(carry):
+            pr, i = carry
+            o = gen(variables, pr)
+            nxt = (o[:, -t0:].astype(jnp.int32) + pr + i) % LM_VOCAB
+            return (nxt, i + 1), o
+
+        def step_pre(carry):
+            pr, i = carry
+            o = pre(variables, pr)
+            nxt = (pr + o[:, :1].astype(jnp.int32) + i) % LM_VOCAB
+            return (nxt, i + 1), o
+
+        sec_gen, _, _ = run_timed(step_gen, (tok, jnp.int32(1)),
+                                  min_time=min_time)
+        sec_pre, _, _ = run_timed(step_pre, (tok, jnp.int32(1)),
+                                  min_time=min_time / 2)
+        dec_ms = (sec_gen - sec_pre) / steps * 1e3
+        key = f"decode_bs{bs}_p{t0}"
+        out[f"{key}_tokens_per_sec"] = round(bs * steps
+                                             / (sec_gen - sec_pre), 1)
+        out[f"{key}_ms_per_token"] = round(dec_ms, 3)
+        if on_tpu:
+            # HBM roofline: every decode step reads all params (bf16)
+            # plus the live KV cache (bf16, 2 x layers x T x D x bs)
+            nparams = sum(x.size for x in
+                          jax.tree.leaves(variables[PARAMS]))
+            t_avg = t0 + steps / 2
+            kv = (2 * LM_BASE["num_layers"] * t_avg
+                  * LM_BASE["model_dim"] * bs)
+            min_ms = (nparams + kv) * 2 / (HBM_GBPS * 1e6)
+            out[f"{key}_hbm_bound_frac"] = round(min_ms / dec_ms, 3)
+    if on_tpu:
+        r = (out.get("decode_bs32_p32_tokens_per_sec", 0)
+             / max(out.get("decode_bs8_p32_tokens_per_sec", 1), 1e-9))
+        out["decode_bs32_vs_bs8"] = round(r, 2)
+        out["decode_note"] = (
+            "frac>1 = weights VMEM-resident across the decode loop "
+            "(70MB bf16 fits); long-prompt points are the HBM-bound "
+            "regime")
+    return out
+
+
+def _packed_vs_padded_bench(min_time: float = 1.0):
+    """Packed ragged batches vs padded batches — the capability the
+    segment-id flash kernel buys (r4 VERDICT #1: the LoD->dense packing
+    idiom, lod_tensor.h:44-58). Seven documents of mixed lengths
+    (512..2048, sum 8192) trained either PACKED into [2, 8192] rows
+    with segment ids + per-doc positions (flash skips cross-doc blocks:
+    cost ~sum len_i^2) or PADDED to [14, 2048] (75% more tokens, all
+    attended). Metric: REAL (non-pad) tokens/s through a full train
+    step; the ratio is the packing win."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.benchmark.harness import run_timed
+    from paddle_tpu.benchmark.models import LM_BASE, LM_VOCAB
+    from paddle_tpu.core.executor import Trainer
+    from paddle_tpu.ops.fused_ce import linear_cross_entropy
+    from paddle_tpu.optim.optimizer import Adam
+
+    from paddle_tpu.models.transformer import CausalLM
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        doc_lens = [512, 768, 1024, 1280, 1536, 1024, 2048]   # sum 8192
+        pad_to, rows = 2048, 2
+    else:
+        doc_lens = [64, 96, 96]                               # sum 256
+        pad_to, rows = 128, 1
+    total = sum(doc_lens)
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    rs = np.random.RandomState(0)
+
+    def make_model(seq):
+        return CausalLM(LM_VOCAB, max_len=seq + 8, dtype=dtype,
+                        **LM_BASE)
+
+    # ---- packed: [rows, total] with segs + per-doc positions ---------
+    segs = np.concatenate([np.full(n, i, np.int32)
+                           for i, n in enumerate(doc_lens)])
+    pos = np.concatenate([np.arange(n, dtype=np.int32)
+                          for n in doc_lens])
+    wts = np.ones(total, np.float32)
+    wts[np.cumsum(doc_lens) - 1] = 0.0       # doc-final predicts across
+    tokens = rs.randint(0, LM_VOCAB, (rows, total + 1)).astype(np.int32)
+    segs_b = jnp.asarray(np.tile(segs, (rows, 1)))
+    pos_b = jnp.asarray(np.tile(pos, (rows, 1)))
+    wts_b = jnp.asarray(np.tile(wts, (rows, 1)))
+
+    def packed_loss(module, variables, batch, rng, training):
+        inp, tgt = batch
+        hid, mut = module.apply(variables, inp, training=training,
+                                rngs=rng, mutable=True,
+                                return_hidden=True, segment_ids=segs_b,
+                                positions=pos_b)
+        w, b = module.head_weights(variables)
+        ce = linear_cross_entropy(hid, w.astype(hid.dtype), tgt, None)
+        return (jnp.sum(ce * wts_b) / jnp.sum(wts_b), {}), \
+            mut.get("state", {})
+
+    out = {}
+    real_tokens = rows * total
+
+    def run(model, loss_fn, batch, label, tokens_per_step):
+        tr = Trainer(model, Adam(1e-4), loss_fn)
+        ts = tr.init_state(jnp.asarray(batch[0]))
+        db = jax.device_put(batch)
+
+        def step(ts):
+            ts, f = tr.train_step(ts, db)
+            return ts, f["loss"]
+
+        sec, _, _ = run_timed(step, ts, min_time=min_time)
+        out[f"{label}_tokens_per_sec"] = round(tokens_per_step / sec, 1)
+        out[f"{label}_ms_per_step"] = round(sec * 1e3, 2)
+
+    run(make_model(total), packed_loss,
+        (tokens[:, :-1], tokens[:, 1:]), "lm_packed", real_tokens)
+
+    # ---- padded: each doc its own row, padded to pad_to --------------
+    n_rows = rows * len(doc_lens)
+    ptoks = np.zeros((n_rows, pad_to + 1), np.int32)
+    pw = np.zeros((n_rows, pad_to), np.float32)
+    r = 0
+    for b in range(rows):
+        off = 0
+        for n in doc_lens:
+            # row b's token stream, cut per doc — both arms train on the
+            # same data
+            ptoks[r, :n + 1] = tokens[b, off:off + n + 1]
+            pw[r, :n - 1 + 1] = 1.0
+            pw[r, n - 1] = 0.0               # last real token: no target
+            off += n
+            r += 1
+    lens_col = np.array([n for _ in range(rows) for n in doc_lens])
+    pseg = jnp.asarray((np.arange(pad_to)[None, :]
+                        < lens_col[:, None]).astype(np.int32))
+    pwts = jnp.asarray(pw)
+
+    def padded_loss(module, variables, batch, rng, training):
+        inp, tgt = batch
+        hid, mut = module.apply(variables, inp, training=training,
+                                rngs=rng, mutable=True,
+                                return_hidden=True, segment_ids=pseg)
+        w, b = module.head_weights(variables)
+        ce = linear_cross_entropy(hid, w.astype(hid.dtype), tgt, None)
+        return (jnp.sum(ce * pwts) / jnp.sum(pwts), {}), \
+            mut.get("state", {})
+
+    run(make_model(pad_to), padded_loss,
+        (ptoks[:, :-1], ptoks[:, 1:]), "lm_padded", real_tokens)
+    out["packed_vs_padded"] = round(
+        out["lm_packed_tokens_per_sec"]
+        / max(out["lm_padded_tokens_per_sec"], 1e-9), 2)
+    return out
+
+
+def _int8_compute_bench(min_time: float = 1.0):
+    """TRUE int8 inference (quant/int8_compute.py): ResNet-50 frozen to
+    int8 MXU compute with calibrated static activation scales, vs the
+    bf16 model — at bs16 (the r4 VERDICT #5 point; bandwidth-bound,
+    int8 loses) and bs128 (compute-bound, int8 wins ~1.4x measured).
+    Accuracy: top-1 agreement + max relative logit error vs bf16."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.benchmark.harness import chain_k, run_timed
+    from paddle_tpu.models import vision as V
+    from paddle_tpu.quant.int8_compute import freeze_int8
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    sizes = (16, 128) if on_tpu else (2,)
+    img = 224 if on_tpu else 64
+    rs = np.random.RandomState(0)
+    out = {}
+    for bs in sizes:
+        x = jnp.asarray(rs.randn(bs, img, img, 3), jnp.float32)
+        model = V.resnet50(1000, dtype=jnp.bfloat16 if on_tpu
+                           else jnp.float32)
+        variables = model.init(jax.random.key(0), x)
+
+        def time_fwd(apply_fn):
+            K = 8 if on_tpu else 2
+            kf = chain_k(lambda c, xx: apply_fn(xx + c), K)
+            sec, _, _ = run_timed(lambda s: (kf(s, x),) * 2,
+                                  jnp.zeros((), x.dtype),
+                                  min_time=min_time)
+            return sec / K * 1e3
+
+        tb = time_fwd(lambda xx: model.apply(variables, xx,
+                                             training=False))
+        ref = np.asarray(model.apply(variables, x, training=False),
+                         np.float32)
+        qmodel, qvars = freeze_int8(model, variables,
+                                    calib_batches=[(x,)])
+        t8 = time_fwd(lambda xx: qmodel.apply(qvars, xx,
+                                              training=False))
+        got = np.asarray(qmodel.apply(qvars, x, training=False),
+                         np.float32)
+        out[f"int8_vs_bf16_bs{bs}"] = round(tb / t8, 2)
+        out[f"resnet50_int8_infer_imgs_per_sec_bs{bs}"] = round(
+            bs / t8 * 1e3, 1)
+        out[f"int8_top1_agree_bs{bs}"] = round(
+            float((got.argmax(-1) == ref.argmax(-1)).mean()), 3)
+        out[f"int8_max_rel_logit_err_bs{bs}"] = round(
+            float(np.abs(got - ref).max()
+                  / (np.abs(ref).max() + 1e-9)), 4)
+    return out
 
 
 def _resnet_s2d(min_time: float, bs: int = 128):
@@ -383,8 +637,16 @@ def main():
 
     on_tpu = _devices_or_reexec()[0].platform == "tpu"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
-    min_time = 2.5 if on_tpu else 0.2
+    # 1.5s windows (was 2.5): every entry is compile-dominated on the
+    # tunnel, and the r4 artifact budget-dropped advertised extras —
+    # smaller windows buy entries (r4 VERDICT missing #1)
+    min_time = 1.5 if on_tpu else 0.2
     bs = 64 if on_tpu else 8
+
+    # weak-scaling runs on a VIRTUAL CPU mesh in its own process: start
+    # it in the background now, collect before printing — it never
+    # again competes with TPU entries for bench budget
+    scaling_proc = _scaling_subprocess_start()
 
     resnet = _retry(lambda: run_model("resnet50", batch_size=bs,
                                       dtype=dtype, min_time=min_time))
@@ -415,25 +677,99 @@ def main():
     except Exception as e:  # primary metric must still print
         extra["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    # Optional entries, most important first; each checks the soft budget
-    # so a slow day degrades to fewer extras, never to a missing line.
-    def _gate(key, est_s=120.0, tpu_only=True):
+    # Entry gate. required=True entries are the NEVER-SKIP set (r4
+    # VERDICT missing #1: the artifact must carry everything the README
+    # claims — decode, s2d, infer, sustained_matmul, scaling, plus the
+    # flash correctness gate); optional entries check the soft budget so
+    # a slow day degrades to fewer extras, never to a missing line.
+    def _gate(key, est_s=120.0, tpu_only=True, required=False):
         if tpu_only and not on_tpu:
             return False
-        if _budget_ok(est_s):
+        if required or _budget_ok(est_s):
             return True
         extra[f"{key}_skipped"] = "bench budget"
         return False
 
-    # flash_check FIRST among optionals: the on-hardware kernel
-    # correctness gate must survive any budget squeeze (r3 VERDICT #1)
-    if _gate("flash_check", est_s=90):
+    # ---- never-skip set -------------------------------------------------
+    if _gate("sustained_matmul", required=True):
+        # same-day matmul ceiling NEXT TO the headline numbers: pool
+        # noise bounds every MFU (r3: 149 TFLOP/s = 76% of peak; r4:
+        # 112 = 57% — without this probe the confound is invisible)
+        try:
+            from paddle_tpu.benchmark.harness import sustained_matmul_flops
+            mp = _retry(lambda: sustained_matmul_flops())
+            if mp:
+                extra["sustained_matmul_tflops"] = round(mp / 1e12, 1)
+        except Exception as e:
+            extra["sustained_matmul_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("flash_check", required=True):
+        # the on-hardware kernel correctness gate (now incl. segment-id
+        # masking and in-kernel dropout) must survive any budget squeeze
         try:
             from paddle_tpu.kernels.selfcheck import flash_selfcheck
             extra.update(_retry(flash_selfcheck))
         except Exception as e:
             extra["flash_check"] = f"FAILED: {type(e).__name__}: {e}"[:220]
 
+    if _gate("lm16k", required=True):  # 16k-token causal-LM TRAIN step:
+        # flash causal attention + fused CE (no [T,V] logits) — the
+        # long-context training headline (SURVEY §5.7)
+        try:
+            lm = _retry(lambda: run_model("lm_longctx", batch_size=1,
+                                          dtype=dtype, min_time=min_time))
+            extra["lm16k_tokens_per_sec"] = round(lm.value, 1)
+            extra["lm16k_mfu"] = round(lm.mfu, 4) if lm.mfu else None
+            extra["lm16k_ms_per_step"] = round(lm.ms_per_step, 2)
+        except Exception as e:
+            extra["lm16k_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("decode", required=True):  # KV-cached generate: bs x prompt
+        # sweep + HBM roofline (bf16 caches; prefill subtracted)
+        try:
+            extra.update(_retry(lambda: _decode_bench(
+                min_time=max(min_time / 2, 0.6))))
+        except Exception as e:
+            extra["decode_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("packed", required=True):  # packed ragged batches through
+        # the segment-id flash kernel vs padded rows (r4 VERDICT #1)
+        try:
+            extra.update(_retry(lambda: _packed_vs_padded_bench(
+                min_time=max(min_time / 2, 0.6))))
+        except Exception as e:
+            extra["packed_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("resnet50_s2d", required=True):  # s2d stem: best measured
+        # ResNet-50 training config (PERF_NOTES: 0.334 MFU at bs=128)
+        try:
+            s2d = _retry(lambda: _resnet_s2d(min_time=min_time))
+            extra["resnet50_s2d_imgs_per_sec_bs128"] = round(s2d.value, 1)
+            extra["resnet50_s2d_mfu"] = (round(s2d.mfu, 4)
+                                         if s2d.mfu else None)
+        except Exception as e:
+            extra["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("infer", required=True):  # inference (reference infer tables)
+        try:
+            from paddle_tpu.benchmark.models import run_infer
+            inf = _retry(lambda: run_infer(
+                "resnet50", batch_size=16, dtype=dtype,
+                min_time=min_time))
+            extra["resnet50_infer_imgs_per_sec_bs16"] = round(inf.value, 1)
+            extra["resnet50_infer_vs_baseline"] = (
+                round(inf.vs_baseline, 1) if inf.vs_baseline else None)
+        except Exception as e:
+            extra["infer_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("int8", required=True):  # TRUE int8 compute (r4 VERDICT #5)
+        try:
+            extra.update(_retry(lambda: _int8_compute_bench(
+                min_time=max(min_time / 2, 0.8))))
+        except Exception as e:
+            extra["int8_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    # ---- optional extras, most important first --------------------------
     if _gate("bert"):  # BERT-base MLM (BASELINE BERT row)
         try:
             b = _retry(lambda: run_model("bert", batch_size=64,
@@ -442,6 +778,25 @@ def main():
             extra["bert_mfu"] = round(b.mfu, 4) if b.mfu else None
         except Exception as e:
             extra["bert_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("moe"):  # MoE dispatch: masked (E×) vs a2a (k·cf×), cf sweep
+        try:
+            extra.update(_retry(lambda: _moe_bench(min_time=min_time)))
+        except Exception as e:
+            extra["moe_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("longcontext"):  # long-context: flash vs dense at 16k
+        try:
+            extra.update(_retry(_longcontext_bench))
+        except Exception as e:
+            extra["longcontext_error"] = f"{type(e).__name__}: {e}"[:160]
+
+    if _gate("ptq", est_s=180):  # int8 PTQ SIMULATION story (the
+        # reference contrib semantics; the true-int8 path is `int8` above)
+        try:
+            extra.update(_retry(lambda: _ptq_bench(min_time=min_time)))
+        except Exception as e:
+            extra["ptq_error"] = f"{type(e).__name__}: {e}"[:160]
 
     if _gate("resnet50_best_bs"):  # best-bs point (report bs=64 AND best)
         try:
@@ -454,58 +809,6 @@ def main():
                                              if best.mfu else None)
         except Exception as e:
             extra["resnet50_best_bs_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("longcontext"):  # long-context: flash vs dense at 16k
-        try:
-            extra.update(_retry(_longcontext_bench))
-        except Exception as e:
-            extra["longcontext_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("lm16k", est_s=180):  # 16k-token causal-LM TRAIN step:
-        # flash causal attention + fused CE (no [T,V] logits) — the
-        # long-context training headline (SURVEY §5.7)
-        try:
-            lm = _retry(lambda: run_model("lm_longctx", batch_size=1,
-                                          dtype=dtype, min_time=min_time))
-            extra["lm16k_tokens_per_sec"] = round(lm.value, 1)
-            extra["lm16k_mfu"] = round(lm.mfu, 4) if lm.mfu else None
-            extra["lm16k_ms_per_step"] = round(lm.ms_per_step, 2)
-        except Exception as e:
-            extra["lm16k_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("moe"):  # MoE dispatch: masked (E×) vs all_to_all (k·cf×)
-        try:
-            extra.update(_retry(lambda: _moe_bench(min_time=min_time)))
-        except Exception as e:
-            extra["moe_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("ptq", est_s=180):  # int8 PTQ inference story (r3 VERDICT #8)
-        try:
-            extra.update(_retry(lambda: _ptq_bench(min_time=min_time)))
-        except Exception as e:
-            extra["ptq_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("resnet50_s2d"):  # s2d stem: the best measured ResNet-50
-        # training config (PERF_NOTES: 0.334 MFU at bs=128)
-        try:
-            s2d = _retry(lambda: _resnet_s2d(min_time=min_time))
-            extra["resnet50_s2d_imgs_per_sec_bs128"] = round(s2d.value, 1)
-            extra["resnet50_s2d_mfu"] = (round(s2d.mfu, 4)
-                                         if s2d.mfu else None)
-        except Exception as e:
-            extra["resnet50_s2d_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("decode", est_s=150):  # KV-cached generate throughput
-        try:
-            extra.update(_retry(lambda: _decode_bench(min_time=min_time)))
-        except Exception as e:
-            extra["decode_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("scaling", est_s=240, tpu_only=False):  # weak-scaling sweep (cpu-mesh subprocess)
-        try:
-            extra.update(_scaling_subprocess())
-        except Exception as e:
-            extra["scaling_error"] = f"{type(e).__name__}: {e}"[:160]
 
     if _gate("transformer_bs64"):  # r3-comparable config, for the series
         try:
@@ -533,30 +836,12 @@ def main():
             except Exception as e:
                 extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:160]
 
-    if _gate("infer"):  # inference (reference infer tables)
-        try:
-            from paddle_tpu.benchmark.models import run_infer
-            inf = _retry(lambda: run_infer(
-                "resnet50", batch_size=16, dtype=dtype,
-                min_time=min_time))
-            extra["resnet50_infer_imgs_per_sec_bs16"] = round(inf.value, 1)
-            extra["resnet50_infer_vs_baseline"] = (
-                round(inf.vs_baseline, 1) if inf.vs_baseline else None)
-        except Exception as e:
-            extra["infer_error"] = f"{type(e).__name__}: {e}"[:160]
-
-    if _gate("sustained_matmul"):  # sustained single-chip matmul ceiling
-        # (state-chained probe; calibrates what fraction of the published
-        # 197 TFLOP/s peak a matmul-dense program actually reaches —
-        # measured ~76%; see PERF_NOTES.md "measurement integrity")
-        try:
-            from paddle_tpu.benchmark.harness import sustained_matmul_flops
-            mp = _retry(lambda: sustained_matmul_flops())
-            if mp:
-                extra["sustained_matmul_tflops"] = round(mp / 1e12, 1)
-        except Exception as e:
-            extra["sustained_matmul_error"] = f"{type(e).__name__}: {e}"[:160]
-
+    # collect the background CPU-mesh weak-scaling sweep (never skipped:
+    # it ran concurrently with everything above)
+    try:
+        extra.update(_scaling_subprocess_join(scaling_proc))
+    except Exception as e:
+        extra["scaling_error"] = f"{type(e).__name__}: {e}"[:160]
 
     out = {
         "metric": f"resnet50_train_imgs_per_sec_bs{bs}",

@@ -50,13 +50,23 @@ def retry_transient(fn: Callable[[], Any], attempts: int = 2) -> Any:
     """Run fn(); retry on failure. The axon tunnel's remote-compile
     channel occasionally drops mid-read ("response body closed") — a
     transient that must not cost a recorded benchmark an entry. Shared by
-    bench.py and the tools/ profilers so the guard can't drift."""
+    bench.py and the tools/ profilers so the guard can't drift.
+
+    The first failure is PRINTED before retrying: deterministic failures
+    (OOM, shape errors) inevitably fail twice, and a silent first attempt
+    would both hide that a retry happened and make the failure look
+    twice as slow as it was."""
+    import sys
     last = None
-    for _ in range(attempts):
+    for i in range(attempts):
         try:
             return fn()
         except Exception as e:  # noqa: BLE001 — any transient counts
             last = e
+            if i + 1 < attempts:
+                print(f"[retry_transient] attempt {i + 1}/{attempts} failed: "
+                      f"{type(e).__name__}: {e}; retrying",
+                      file=sys.stderr, flush=True)
     raise last
 
 

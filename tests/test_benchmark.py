@@ -91,3 +91,43 @@ def test_bert_bench_and_scaling():
     assert "bert_dp2_scaling_eff" in s
     assert s["scaling_platform"] == "cpu"
     assert "bert_dp2_vs_shared_core_ideal" in s
+
+
+def test_fused_ce_scan_body_counted_once():
+    """The analytic MFU correction (ops/fused_ce.mfu_flops_correction,
+    applied in benchmark/models.py) assumes XLA's cost analysis counts a
+    lax.scan body EXACTLY ONCE, independent of trip count (counted fused
+    flops = 8*N*D*chunk). If an XLA version starts counting per-trip the
+    reported MFU would silently inflate — this pins the behavior so the
+    change fails loudly instead."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.fused_ce import linear_cross_entropy
+
+    N, D, c = 64, 32, 128
+    rs = np.random.RandomState(0)
+    h = jnp.asarray(rs.randn(N, D), jnp.float32)
+
+    def flops_for(vocab):
+        tgt = jnp.asarray(rs.randint(0, vocab, (N,)), jnp.int32)
+        w = jnp.asarray(rs.randn(D, vocab), jnp.float32)
+        f = jax.jit(jax.grad(
+            lambda h, w: jnp.sum(linear_cross_entropy(h, w, tgt, None,
+                                                      chunk=c)),
+            argnums=(0, 1)))
+        return compiled_flops(f, h, w)
+
+    two_trips = flops_for(2 * c)
+    four_trips = flops_for(4 * c)
+    if two_trips is None or four_trips is None:  # cost analysis off
+        return
+    body = 8 * N * D * c
+    # trip-count invariance: same body size => same counted flops
+    assert abs(four_trips - two_trips) < 0.05 * body, (
+        "scan body no longer counted once: "
+        f"2-trip={two_trips} 4-trip={four_trips}")
+    # magnitude: counted ~= the 8*N*D*chunk model the correction assumes
+    assert 0.8 * body < two_trips < 1.2 * body, (
+        f"counted fused-CE flops {two_trips} drifted from the "
+        f"8*N*D*chunk model ({body})")
