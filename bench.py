@@ -23,6 +23,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 # Soft wall-clock budget: optional entries are skipped (with a marker)
@@ -71,21 +72,33 @@ def _scaling_subprocess_start():
         " per_chip_batch=8, min_time=0.3)\n"
         "out.update(scaling_summary(rows, prefix='bert_'))\n"
         "print('SCALING ' + json.dumps(out))\n")
-    return subprocess.Popen([sys.executable, "-c", code], cwd=here,
-                            env=env, stdout=subprocess.PIPE,
-                            stderr=subprocess.PIPE, text=True)
+    # stdout/stderr go to a FILE, not a pipe: JAX/absl warnings exceed
+    # the pipe buffer long before the sweep finishes, and an undrained
+    # pipe would block the child until the final join — serializing the
+    # "background" work exactly where it must overlap the TPU entries
+    out_f = tempfile.TemporaryFile(mode="w+", encoding="utf-8")
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=here,
+                            env=env, stdout=out_f,
+                            stderr=subprocess.STDOUT, text=True)
+    proc._ptpu_out = out_f          # keep the fd alive with the handle
+    return proc
 
 
 def _scaling_subprocess_join(proc, timeout: float = 900):
     try:
-        stdout, stderr = proc.communicate(timeout=timeout)
+        proc.wait(timeout=timeout)
     except subprocess.TimeoutExpired:
         proc.kill()
+        proc.wait()                 # reap — no zombie for the bench's life
         return {"scaling_error": f"scaling subprocess >{timeout:.0f}s"}
-    for line in stdout.splitlines():
+    out_f = proc._ptpu_out
+    out_f.seek(0)
+    text = out_f.read()
+    out_f.close()
+    for line in text.splitlines():
         if line.startswith("SCALING "):
             return json.loads(line[len("SCALING "):])
-    return {"scaling_error": (stderr or stdout)[-200:]}
+    return {"scaling_error": text[-200:]}
 
 
 def _longcontext_bench(seq: int = 16384):
@@ -320,10 +333,13 @@ def _decode_bench(min_time: float = 0.8):
                                   min_time=min_time)
         sec_pre, _, _ = run_timed(step_pre, (tok, jnp.int32(1)),
                                   min_time=min_time / 2)
-        dec_ms = (sec_gen - sec_pre) / steps * 1e3
+        # two independently-noisy windows: clamp the subtraction so a
+        # prefill-dominated point on a noisy pool day cannot emit a
+        # negative rate or divide by zero (keep >=5% of the gen window)
+        dec_sec = max(sec_gen - sec_pre, sec_gen * 0.05)
+        dec_ms = dec_sec / steps * 1e3
         key = f"decode_bs{bs}_p{t0}"
-        out[f"{key}_tokens_per_sec"] = round(bs * steps
-                                             / (sec_gen - sec_pre), 1)
+        out[f"{key}_tokens_per_sec"] = round(bs * steps / dec_sec, 1)
         out[f"{key}_ms_per_token"] = round(dec_ms, 3)
         if on_tpu:
             # HBM roofline: every decode step reads all params (bf16)
@@ -394,16 +410,19 @@ def _packed_vs_padded_bench(min_time: float = 1.0):
     pos_b = jnp.asarray(np.tile(pos, (rows, 1)))
     wts_b = jnp.asarray(np.tile(wts, (rows, 1)))
 
-    def packed_loss(module, variables, batch, rng, training):
-        inp, tgt = batch
-        hid, mut = module.apply(variables, inp, training=training,
-                                rngs=rng, mutable=True,
-                                return_hidden=True, segment_ids=segs_b,
-                                positions=pos_b)
-        w, b = module.head_weights(variables)
-        ce = linear_cross_entropy(hid, w.astype(hid.dtype), tgt, None)
-        return (jnp.sum(ce * wts_b) / jnp.sum(wts_b), {}), \
-            mut.get("state", {})
+    def make_loss(seg_ids, positions, weights):
+        def loss_fn(module, variables, batch, rng, training):
+            hid, mut = module.apply(variables, batch[0], training=training,
+                                    rngs=rng, mutable=True,
+                                    return_hidden=True,
+                                    segment_ids=seg_ids,
+                                    positions=positions)
+            w, _ = module.head_weights(variables)
+            ce = linear_cross_entropy(hid, w.astype(hid.dtype),
+                                      batch[1], None)
+            return (jnp.sum(ce * weights) / jnp.sum(weights), {}), \
+                mut.get("state", {})
+        return loss_fn
 
     out = {}
     real_tokens = rows * total
@@ -421,7 +440,7 @@ def _packed_vs_padded_bench(min_time: float = 1.0):
         out[f"{label}_tokens_per_sec"] = round(tokens_per_step / sec, 1)
         out[f"{label}_ms_per_step"] = round(sec * 1e3, 2)
 
-    run(make_model(total), packed_loss,
+    run(make_model(total), make_loss(segs_b, pos_b, wts_b),
         (tokens[:, :-1], tokens[:, 1:]), "lm_packed", real_tokens)
 
     # ---- padded: each doc its own row, padded to pad_to --------------
@@ -444,17 +463,7 @@ def _packed_vs_padded_bench(min_time: float = 1.0):
                         < lens_col[:, None]).astype(np.int32))
     pwts = jnp.asarray(pw)
 
-    def padded_loss(module, variables, batch, rng, training):
-        inp, tgt = batch
-        hid, mut = module.apply(variables, inp, training=training,
-                                rngs=rng, mutable=True,
-                                return_hidden=True, segment_ids=pseg)
-        w, b = module.head_weights(variables)
-        ce = linear_cross_entropy(hid, w.astype(hid.dtype), tgt, None)
-        return (jnp.sum(ce * pwts) / jnp.sum(pwts), {}), \
-            mut.get("state", {})
-
-    run(make_model(pad_to), padded_loss,
+    run(make_model(pad_to), make_loss(pseg, None, pwts),
         (ptoks[:, :-1], ptoks[:, 1:]), "lm_padded", real_tokens)
     out["packed_vs_padded"] = round(
         out["lm_packed_tokens_per_sec"]
@@ -643,10 +652,13 @@ def main():
     min_time = 1.5 if on_tpu else 0.2
     bs = 64 if on_tpu else 8
 
-    # weak-scaling runs on a VIRTUAL CPU mesh in its own process: start
-    # it in the background now, collect before printing — it never
-    # again competes with TPU entries for bench budget
-    scaling_proc = _scaling_subprocess_start()
+    # weak-scaling runs on a VIRTUAL CPU mesh in its own process. On TPU
+    # it starts NOW and overlaps the device-bound entries (host CPU is
+    # nearly idle between dispatches, so the contention is the tunnel
+    # sync cost at worst); on a CPU-only run it would steal the very
+    # cores the foreground entries are timed on, so there it runs
+    # sequentially at the end.
+    scaling_proc = _scaling_subprocess_start() if on_tpu else None
 
     resnet = _retry(lambda: run_model("resnet50", batch_size=bs,
                                       dtype=dtype, min_time=min_time))
@@ -779,7 +791,8 @@ def main():
         except Exception as e:
             extra["bert_error"] = f"{type(e).__name__}: {e}"[:160]
 
-    if _gate("moe"):  # MoE dispatch: masked (E×) vs a2a (k·cf×), cf sweep
+    if _gate("moe", est_s=240):  # MoE dispatch: masked (E×) vs a2a
+        # (k·cf×) + the cf 1.0/2.0 sweep — 4 timed configs
         try:
             extra.update(_retry(lambda: _moe_bench(min_time=min_time)))
         except Exception as e:
@@ -836,9 +849,12 @@ def main():
             except Exception as e:
                 extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:160]
 
-    # collect the background CPU-mesh weak-scaling sweep (never skipped:
-    # it ran concurrently with everything above)
+    # collect the CPU-mesh weak-scaling sweep (never skipped: on TPU it
+    # ran concurrently with everything above; on CPU it runs now,
+    # sequentially, so it never contended with the timed entries)
     try:
+        if scaling_proc is None:
+            scaling_proc = _scaling_subprocess_start()
         extra.update(_scaling_subprocess_join(scaling_proc))
     except Exception as e:
         extra["scaling_error"] = f"{type(e).__name__}: {e}"[:160]

@@ -90,10 +90,14 @@ def normalize_segment_ids(segment_ids, b: int, t_q: int, t_k: int):
 
 def _default_blocks(t_q: int, t_k: int):
     # v5e-measured: (512,512) best at T<=2048 (2.91 ms @1024/bs16);
-    # (512,1024) best at long T (13.95 ms @16k/bs1 vs 27.3 for (256,512)
-    # and 85.9 for XLA dense).
+    # (1024,1024) best at long T — the round-5 roofline sweep
+    # (tools/flash_roofline.py, ceiling-relative): fwd 85.9% of the
+    # same-day sustained-matmul rate at 16k vs 70.7% for the previous
+    # (512,1024) default (arithmetic intensity 334 vs 204 FLOP/B —
+    # comfortably compute-bound either way; the win is fewer grid steps
+    # amortizing per-block scratch/loop overhead).
     if t_k > 2048:
-        return 512, 1024
+        return 1024, 1024
     return 512, 512
 
 
