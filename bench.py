@@ -782,6 +782,18 @@ def main():
             extra["int8_error"] = f"{type(e).__name__}: {e}"[:160]
 
     # ---- optional extras, most important first --------------------------
+    # The never-skip set ignores the soft budget and can consume all of
+    # it on a slow pool day; guarantee the top optionals (bert, moe,
+    # longcontext — all README-referenced; gate estimates sum to 480s)
+    # a post-required allowance so "required ran long" degrades the
+    # tail, not the headlines. ONLY when the operator did not pin the
+    # budget explicitly: an explicit PTPU_BENCH_BUDGET_S means a hard
+    # external deadline, and overshooting it risks the driver killing
+    # the run before the one JSON line prints — worse than any skip.
+    global _BUDGET_S
+    if "PTPU_BENCH_BUDGET_S" not in os.environ:
+        _BUDGET_S = max(_BUDGET_S, _elapsed() + 480)
+
     if _gate("bert"):  # BERT-base MLM (BASELINE BERT row)
         try:
             b = _retry(lambda: run_model("bert", batch_size=64,
