@@ -873,14 +873,21 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
                           batch_axes: Sequence[str] = ("dp",),
                           ep_axis: Optional[str] = "ep",
                           lb_weight: float = 0.01,
-                          fused_ce: bool = False):
+                          fused_ce: bool = False,
+                          schedule: str = "gpipe"):
     """MeshTrainer loss_fn training PipelinedMoELM: CE streamed on the
     last stage + lb_weight × the Switch load-balance aux averaged over
     every (stage, microbatch). Expert stacks shard over `ep_axis`
     (pp×ep×dp); pair with `pipeline_moe_rules(axis, ep_axis)`.
     `fused_ce` as in pipelined_lm_loss (chunked linear+CE, no [N, V]
-    logits materialization).
+    logits materialization). `schedule` as in pipelined_lm_loss:
+    "1f1b" runs the O(S)-activation interleaved backward — the stage-aux
+    (load-balance) cotangent rides the same in-tick vjp, and the ep
+    psums transpose exactly under the vma machinery (parity-tested).
     """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule must be 'gpipe' or '1f1b', "
+                         f"got {schedule!r}")
     baxes = tuple(a for a in batch_axes if a in mesh.shape)
     ep = ep_axis if ep_axis is not None and mesh.shape.get(ep_axis, 1) > 1 \
         else None
@@ -909,7 +916,9 @@ def pipelined_moe_lm_loss(mesh: Mesh, axis: str = "pp",
                                  capacity_factor=module.capacity_factor)
             return y, lb_weight * lb
 
-        stream = pipeline_stream(
+        builder = (pipeline_stream_1f1b if schedule == "1f1b"
+                   else pipeline_stream)
+        stream = builder(
             stage, _lm_consume(fused_ce), mesh, axis, batch_axes=baxes,
             param_specs=_moe_stage_specs(axis, ep))
         loss = stream(p["stages"], (p["lnf_s"], p["lnf_b"], p["head"]),

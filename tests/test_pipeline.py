@@ -804,3 +804,43 @@ def test_1f1b_activation_liveness_below_gpipe(mesh):
         return comp.memory_analysis().temp_size_in_bytes
 
     assert temp_bytes("1f1b") * 2 < temp_bytes("gpipe")
+
+
+def test_1f1b_moe_matches_gpipe():
+    """PipelinedMoELM under the 1F1B schedule (pp=2 x ep=2 x dp=2): the
+    stage-aux (load-balance) cotangent and the in-stage ep psums ride
+    the in-tick vjp — loss and post-step params must match gpipe."""
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import DistStrategy, MeshTrainer
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.parallel.pipeline import (PipelinedMoELM,
+                                              pipeline_moe_rules,
+                                              pipelined_moe_lm_loss)
+
+    mesh = make_mesh(MeshConfig(pp=2, ep=2, dp=2))
+    model = PipelinedMoELM(32, d_model=16, n_heads=2, d_ff=32,
+                           num_stages=2, max_len=8, num_experts=4,
+                           top_k=2, capacity_factor=4.0)
+    rs = np.random.RandomState(21)
+    tok = rs.randint(0, 32, (16, 9)).astype(np.int32)
+    batch = (tok[:, :-1], tok[:, 1:])
+
+    def mk(schedule):
+        return MeshTrainer(
+            model, Adam(1e-2),
+            pipelined_moe_lm_loss(mesh, num_microbatches=4,
+                                  schedule=schedule),
+            mesh, strategy=DistStrategy(batch_axes=("dp",)),
+            rules=pipeline_moe_rules())
+
+    t1, tg = mk("1f1b"), mk("gpipe")
+    ts1 = t1.init_state(jnp.asarray(batch[0]))
+    ts1, f1 = t1.train_step(ts1, t1.put_batch(batch))
+    tsg = tg.init_state(jnp.asarray(batch[0]))
+    tsg, fg = tg.train_step(tsg, tg.put_batch(batch))
+    assert float(f1["loss"]) == pytest.approx(float(fg["loss"]),
+                                              rel=2e-5, abs=2e-5)
+    for a, b in zip(jax.tree.leaves(ts1.params),
+                    jax.tree.leaves(tsg.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=2e-3)
