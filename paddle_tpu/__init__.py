@@ -24,6 +24,10 @@ Capability-equivalent of PaddlePaddle Fluid ~1.2 (the reference at
 - `paddle_tpu.benchmark` — model-zoo benchmark harness with MFU (≈
   benchmark/fluid/fluid_benchmark.py)
 - `paddle_tpu.testing` — numeric-gradient OpTest harness (≈ op_test.py)
+- `paddle_tpu.resilience` — fault-tolerant training runtime: preemption
+  supervisor, checkpoint integrity + fallback, bad-step rollback, retry
+  with backoff, chaos injection (no reference analog — SURVEY §5.3's
+  gap; see RESILIENCE.md)
 """
 
 from paddle_tpu.utils.flags import FLAGS, get_flags, set_flags
@@ -45,7 +49,7 @@ def __getattr__(name):
     import importlib
     if name in ("data", "io", "metrics", "models", "parallel", "kernels",
                 "profiler", "serving", "recordio", "benchmark", "testing",
-                "quant"):
+                "quant", "resilience"):
         try:
             return importlib.import_module(f"paddle_tpu.{name}")
         except ModuleNotFoundError as e:

@@ -1,6 +1,7 @@
 from paddle_tpu.io.checkpoint import (
     load_checkpoint, load_persistables, save_checkpoint, save_persistables,
-    latest_checkpoint, AsyncCheckpointer, CheckpointManager,
+    latest_checkpoint, list_checkpoints, checkpoint_step, verify_checkpoint,
+    AsyncCheckpointer, CheckpointIntegrityError, CheckpointManager,
 )
 from paddle_tpu.io.inference import (
     save_inference_model, load_inference_model, InferencePredictor,

@@ -43,15 +43,51 @@ import re
 import shutil
 import tempfile
 import threading
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience.retry import RetryPolicy, retry_call
+from paddle_tpu.utils.log import resilience_event
+
 Pytree = Any
 
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"  # version-1 layout (read-compat only)
+
+# Shared-FS writes/reads see transient errors (NFS timeouts, GCS 5xx);
+# bounded retries here, content errors (CRC) are NOT retryable.
+_IO_RETRY = RetryPolicy(attempts=3, base_delay=0.1, max_delay=2.0,
+                        retry_on=(OSError,))
+# A barrier re-wait reuses the SAME key (peers that already joined are
+# still blocked on us), but a DEADLINE error means they moved on —
+# re-waiting can only hang again, so give up on those.
+_BARRIER_RETRY = RetryPolicy(
+    attempts=2, base_delay=0.2, max_delay=2.0, retry_on=(RuntimeError,),
+    giveup=lambda e: "deadline" in str(e).lower()
+    or "timed out" in str(e).lower())
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint exists on disk but its content cannot be trusted:
+    unreadable/garbled manifest, missing shard file, or a CRC32/size
+    mismatch (torn write, bit rot). `CheckpointManager.restore_latest`
+    treats it as "skip this checkpoint, try the next-newest"."""
+
+
+def _crc32_file(path: str) -> Tuple[int, int]:
+    """(crc32, size) of a file, streamed."""
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                return crc, size
+            crc = zlib.crc32(block, crc)
+            size += len(block)
 
 
 def _flatten(tree: Pytree) -> List[Tuple[str, Any]]:
@@ -99,7 +135,13 @@ def _barrier(name: str) -> None:
             seq = _barrier_seq.get(name, 0)
             _barrier_seq[name] = seq + 1
         key = f"ptpu-ckpt:{seq}:{name}".replace("/", "|")
-        client.wait_at_barrier(key, 600_000)
+
+        def wait():
+            _chaos.maybe_fail("barrier")
+            client.wait_at_barrier(key, 600_000)
+        # transient RPC failure before joining: peers are still blocked
+        # on us, so a re-wait on the same key completes the rendezvous
+        retry_call(wait, policy=_BARRIER_RETRY, name="barrier")
         return
     # No coordination client (private jax API moved?): the device-
     # collective fallback is only safe on the main thread — from a
@@ -264,10 +306,14 @@ def _write_snapshot(path: str, snap, step: Optional[int],
             dir=os.path.dirname(os.path.abspath(path)) or ".")
     try:
         try:
-            np.savez(os.path.join(tmp, f"shards-p{proc}.npz"), **my_shards)
-            with open(os.path.join(tmp, f"shard_index-p{proc}.json"),
-                      "w") as f:
-                json.dump(my_index, f)
+            def write_shards():
+                _chaos.maybe_fail("ckpt_write")
+                np.savez(os.path.join(tmp, f"shards-p{proc}.npz"),
+                         **my_shards)
+                with open(os.path.join(tmp, f"shard_index-p{proc}.json"),
+                          "w") as f:
+                    json.dump(my_index, f)
+            retry_call(write_shards, policy=_IO_RETRY, name="ckpt_write")
         except BaseException as e:
             if multi:
                 _mark_failure(path, proc, e)
@@ -277,9 +323,20 @@ def _write_snapshot(path: str, snap, step: Optional[int],
         _check_failures(path)
         if proc == 0:
             try:
+                # Per-shard CRC32s into the manifest: proc 0 reads every
+                # peer's staged files back through the shared FS — the
+                # checksum covers what actually landed on disk, and a
+                # file the FS hasn't surfaced yet fails the save loudly
+                # instead of committing a torn checkpoint.
+                files = {}
+                for name in sorted(os.listdir(tmp)):
+                    if name.startswith(("shards-p", "shard_index-p")):
+                        crc, size = _crc32_file(os.path.join(tmp, name))
+                        files[name] = {"crc32": crc, "bytes": size}
                 manifest = {"version": 2, "step": step,
                             "metadata": metadata or {},
                             "process_count": jax.process_count(),
+                            "files": files,
                             "leaves": leaves_meta}
                 with open(os.path.join(tmp, _MANIFEST), "w") as f:
                     json.dump(manifest, f, indent=1)
@@ -316,24 +373,60 @@ class _ShardSource:
         self.pieces: Dict[int, List[Tuple[Tuple[Tuple[int, int], ...],
                                           Any, str]]] = {}
         self._files: Dict[Any, Any] = {}
+        # fname -> recorded {"crc32", "bytes"}; absent on v1/older-v2
+        # checkpoints, which load unverified (read-compat)
+        self._sums: Dict[str, dict] = manifest.get("files") or {}
+        self._verified: set = set()
         if self.version == 1:
             for i, meta in enumerate(manifest["leaves"]):
                 spans = tuple((0, d) for d in meta["shape"])
                 self.pieces[i] = [(spans, _ARRAYS, meta["slot"])]
         else:
             for p in range(manifest.get("process_count", 1)):
-                index_path = os.path.join(path, f"shard_index-p{p}.json")
-                with open(index_path) as f:
-                    index = json.load(f)
+                iname = f"shard_index-p{p}.json"
+                self._verify(iname)
+                try:
+                    with open(os.path.join(path, iname)) as f:
+                        index = json.load(f)
+                except json.JSONDecodeError as e:
+                    raise CheckpointIntegrityError(
+                        f"checkpoint {path}: {iname} is not valid JSON "
+                        f"({e})") from e
                 fname = f"shards-p{p}.npz"
                 for rec in index:
                     spans = tuple((a, b) for a, b in rec["index"])
                     self.pieces.setdefault(rec["leaf"], []).append(
                         (spans, fname, rec["slot"]))
 
+    def _verify(self, fname: str) -> None:
+        """CRC32/size check of `fname` against the manifest record,
+        once per file, lazily — a multi-host restore only pays for the
+        shard files it actually opens."""
+        if fname in self._verified:
+            return
+        meta = self._sums.get(fname)
+        if meta is not None:
+            full = os.path.join(self.path, fname)
+            if not os.path.exists(full):
+                raise CheckpointIntegrityError(
+                    f"checkpoint {self.path}: missing {fname}")
+            crc, size = _crc32_file(full)
+            if size != meta["bytes"] or crc != meta["crc32"]:
+                raise CheckpointIntegrityError(
+                    f"checkpoint {self.path}: {fname} corrupt "
+                    f"(crc32 {crc:#x} != {meta['crc32']:#x} or "
+                    f"{size} != {meta['bytes']} bytes)")
+        self._verified.add(fname)
+
     def _slot(self, fname: str, slot: str) -> np.ndarray:
         if fname not in self._files:
-            self._files[fname] = np.load(os.path.join(self.path, fname))
+            self._verify(fname)
+
+            def load():
+                _chaos.maybe_fail("ckpt_read")
+                return np.load(os.path.join(self.path, fname))
+            self._files[fname] = retry_call(load, policy=_IO_RETRY,
+                                            name="ckpt_read")
         return self._files[fname][slot]
 
     def read_region(self, leaf: int, region: Tuple[slice, ...],
@@ -487,17 +580,75 @@ load_persistables = load_checkpoint
 _CKPT_RE = re.compile(r"^ckpt-(\d+)$")
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
+def list_checkpoints(directory: str) -> List[Tuple[int, str]]:
+    """Committed checkpoints as [(step, path)], NEWEST first. Only
+    exact `ckpt-{step}` names count — `ckpt-{step}.ptmp` staging dirs
+    (an uncommitted save in flight or crashed mid-write) and anything
+    without a manifest are never offered for restore."""
     if not os.path.isdir(directory):
-        return None
-    best = None
+        return []
+    out = []
     for name in os.listdir(directory):
         m = _CKPT_RE.match(name)
         if m and os.path.exists(os.path.join(directory, name, _MANIFEST)):
-            step = int(m.group(1))
-            if best is None or step > best[0]:
-                best = (step, os.path.join(directory, name))
-    return best[1] if best else None
+            out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    ckpts = list_checkpoints(directory)
+    return ckpts[0][1] if ckpts else None
+
+
+def checkpoint_step(path: str) -> Optional[int]:
+    """The manifest's recorded step (None for stepless saves)."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        return json.load(f).get("step")
+
+
+def verify_checkpoint(path: str) -> Dict:
+    """Validate a committed checkpoint end to end and return its
+    manifest: manifest parses, every recorded file exists, and every
+    CRC32/size matches. Checkpoints written before checksums existed
+    (and version-1 single-npz saves) pass on existence alone.
+
+    Raises CheckpointIntegrityError with the first failure — the
+    message is what restore_latest logs in its `ckpt_reject` event."""
+    manifest_path = os.path.join(path, _MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except OSError as e:
+        raise CheckpointIntegrityError(
+            f"checkpoint {path}: manifest unreadable ({e})") from e
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointIntegrityError(
+            f"checkpoint {path}: manifest is not valid JSON ({e})") from e
+    files = manifest.get("files")
+    if files:
+        for fname, meta in sorted(files.items()):
+            full = os.path.join(path, fname)
+            if not os.path.exists(full):
+                raise CheckpointIntegrityError(
+                    f"checkpoint {path}: missing {fname}")
+            crc, size = _crc32_file(full)
+            if size != meta["bytes"] or crc != meta["crc32"]:
+                raise CheckpointIntegrityError(
+                    f"checkpoint {path}: {fname} corrupt "
+                    f"(crc32 {crc:#x} != {meta['crc32']:#x} or "
+                    f"{size} != {meta['bytes']} bytes)")
+    elif manifest.get("version", 1) == 1:
+        if not os.path.exists(os.path.join(path, _ARRAYS)):
+            raise CheckpointIntegrityError(
+                f"checkpoint {path}: missing {_ARRAYS}")
+    else:
+        for p in range(manifest.get("process_count", 1)):
+            for fname in (f"shards-p{p}.npz", f"shard_index-p{p}.json"):
+                if not os.path.exists(os.path.join(path, fname)):
+                    raise CheckpointIntegrityError(
+                        f"checkpoint {path}: missing {fname}")
+    return manifest
 
 
 class AsyncCheckpointer:
@@ -565,16 +716,39 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         self._async = AsyncCheckpointer() if async_save else None
         os.makedirs(directory, exist_ok=True)
+        # Stale failure markers from a PREVIOUS crashed run would poison
+        # this run's first save: _clear_markers inside save_checkpoint
+        # only runs on proc 0 / for the exact path being saved, so a
+        # marker a dead peer left for a DIFFERENT step (one this run
+        # resumes past and never re-saves) survived until _check_failures
+        # tripped over it. Managers are constructed before any save on
+        # every process (the same cadence contract saves already have),
+        # so sweeping at init cannot race an in-flight save's markers.
+        for name in os.listdir(directory):
+            if ".err-p" in name:
+                try:
+                    os.remove(os.path.join(directory, name))
+                except OSError:
+                    pass
 
     def save(self, tree: Pytree, step: int,
              metadata: Optional[Dict] = None) -> str:
         path = os.path.join(self.directory, f"ckpt-{step}")
         if self._async is not None:
-            return self._async.save(path, tree, step=step,
-                                    metadata=metadata, _after=self._gc)
+            return self._async.save(
+                path, tree, step=step, metadata=metadata,
+                _after=lambda: self._post_commit(path, step))
         save_checkpoint(path, tree, step=step, metadata=metadata)
-        self._gc()
+        self._post_commit(path, step)
         return path
+
+    def _post_commit(self, path: str, step: int) -> None:
+        # chaos corruption happens AFTER commit, once (proc 0), so a
+        # test's torn-checkpoint scenario matches a real torn write:
+        # the manifest promises content the files no longer have
+        if not _is_multiprocess() or jax.process_index() == 0:
+            _chaos.maybe_corrupt_checkpoint(path, step)
+        self._gc()
 
     def wait(self) -> None:
         if self._async is not None:
@@ -583,13 +757,25 @@ class CheckpointManager:
     def restore_latest(self, target: Optional[Pytree] = None,
                        shardings: Optional[Pytree] = None
                        ) -> Tuple[Optional[Pytree], Optional[int]]:
+        """Restore the newest INTACT checkpoint. A torn or corrupt
+        latest (integrity failure, garbled manifest/index, missing
+        shards, structural mismatch with `target`) is rejected with a
+        `ckpt_reject` event and the next-newest is tried — a bad disk
+        costs the run a few steps of progress, never the whole job.
+        Every process verifies the full file set against the same
+        manifest, so a multi-host restore converges on the same step."""
         self.wait()   # an in-flight async save IS the latest checkpoint
-        path = latest_checkpoint(self.directory)
-        if path is None:
-            return None, None
-        with open(os.path.join(path, _MANIFEST)) as f:
-            step = json.load(f).get("step")
-        return load_checkpoint(path, target, shardings), step
+        for step, path in list_checkpoints(self.directory):
+            try:
+                manifest = verify_checkpoint(path)
+                return (load_checkpoint(path, target, shardings),
+                        manifest.get("step"))
+            except (CheckpointIntegrityError, OSError, ValueError,
+                    KeyError) as e:
+                resilience_event(
+                    "ckpt_reject", ckpt=os.path.basename(path), step=step,
+                    reason=f"{type(e).__name__}: {e}")
+        return None, None
 
     def _gc(self) -> None:
         if _is_multiprocess() and jax.process_index() != 0:

@@ -18,14 +18,36 @@ from typing import Optional
 
 import jax
 
+from paddle_tpu.resilience import chaos as _chaos
+from paddle_tpu.resilience.retry import RetryPolicy, retry_call
+
 _initialized = False
+
+
+def _init_retry_policy() -> RetryPolicy:
+    """Rendezvous flaps (coordinator not up yet, slice mid-reschedule)
+    are the NORMAL startup mode of a preemptible fleet — every worker
+    restarts at its own pace, so first-contact failures deserve real
+    retries. Knobs: PTPU_INIT_RETRIES (attempts, default 3) and
+    PTPU_RETRY_SCALE (global sleep scale, see resilience.retry)."""
+    try:
+        attempts = int(os.environ.get("PTPU_INIT_RETRIES", "3"))
+    except ValueError:
+        attempts = 3
+    return RetryPolicy(attempts=max(1, attempts), base_delay=0.5,
+                       max_delay=15.0, retry_on=(RuntimeError, OSError))
 
 
 def init_distributed(coordinator: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      local_device_ids: Optional[list] = None) -> None:
-    """Initialise multi-host JAX. Idempotent. Single-process if no config."""
+    """Initialise multi-host JAX. Idempotent. Single-process if no config.
+
+    The rendezvous is retried with exponential backoff + deterministic
+    jitter (resilience.retry): a transient coordinator flap at startup
+    — the common case when a preempted slice is being rescheduled —
+    resolves by itself instead of failing the whole job."""
     global _initialized
     if _initialized:
         return
@@ -39,11 +61,17 @@ def init_distributed(coordinator: Optional[str] = None,
     if coordinator is None and num_processes is None:
         _initialized = True  # single-process mode
         return
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids)
+
+    def rendezvous():
+        _chaos.maybe_fail("init_distributed")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids)
+
+    retry_call(rendezvous, policy=_init_retry_policy(),
+               name="init_distributed")
     _initialized = True
 
 

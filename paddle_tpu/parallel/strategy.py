@@ -54,3 +54,9 @@ class DistStrategy:
     donate_state: bool = True
     # loss scaling for bf16/fp16 training
     loss_scale: Optional[float] = None
+    # bad-step guard (resilience layer): with a budget N, a step whose
+    # loss or any grad is non-finite applies NO update (state selected
+    # unchanged in-graph) and after N consecutive such steps the trainer
+    # raises BadStepBudgetExceeded for a checkpoint rollback. None
+    # disables the guard (no extra isfinite reduction, no host sync).
+    bad_step_budget: Optional[int] = None

@@ -34,7 +34,9 @@ from paddle_tpu.core.module import Module, PARAMS, STATE
 from paddle_tpu.optim.optimizer import Optimizer
 from paddle_tpu.parallel.sharding import ShardingRules, fsdp_rules
 from paddle_tpu.parallel.strategy import DistStrategy, ReduceStrategy
+from paddle_tpu.resilience.errors import BadStepBudgetExceeded
 from paddle_tpu.utils.flags import FLAGS
+from paddle_tpu.utils.log import resilience_event
 
 Pytree = Any
 
@@ -64,6 +66,7 @@ class MeshTrainer:
         self._train_step = None
         self._eval_step = None
         self._state_shardings = None
+        self._consecutive_bad = 0  # bad-step guard budget tracking
 
     # -- sharding helpers -------------------------------------------------
     def batch_sharding(self, leaf=None) -> NamedSharding:
@@ -139,6 +142,7 @@ class MeshTrainer:
         accum = self.strategy.gradient_accumulation_steps
         optimizer = self.optimizer
         seed = self.seed
+        guard = self.strategy.bad_step_budget is not None
 
         def step_fn(ts: TrainState, batch, rng):
             if rng is None:
@@ -182,6 +186,20 @@ class MeshTrainer:
             new_params, new_opt = optimizer.apply(
                 ts.params, grads, ts.opt_state)
             new_ts = TrainState(new_params, new_state, new_opt, ts.step + 1)
+            if guard:
+                # Bad-step guard: one fused isfinite reduction over loss
+                # + grads, then select-old on EVERY leaf (params, BN
+                # state, opt moments AND step) — a non-finite step is a
+                # true no-op, not a zero-grad Adam update (which would
+                # still decay moments and advance bias correction). The
+                # select runs in-graph, so donated input buffers are
+                # never resurrected on the host side.
+                finite = jnp.isfinite(loss)
+                for g in jax.tree.leaves(grads):
+                    finite &= jnp.isfinite(g).all()
+                new_ts = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_ts, ts)
+                return new_ts, {"loss": loss, "bad_step": ~finite, **aux}
             return new_ts, {"loss": loss, **aux}
 
         donate = (0,) if self.strategy.donate_state else ()
@@ -219,11 +237,39 @@ class MeshTrainer:
         with RecordEvent("MeshTrainer.train_step"), self.mesh:
             new_ts, fetches = self._train_step(ts, batch, rng)
         hint = getattr(ts, "_step_hint", None)
-        if hint is not None:
+        budget = self.strategy.bad_step_budget
+        if budget is not None:
+            # guard mode accepts one host sync per step: the skip/raise
+            # decision is host control flow by design (rollback leaves
+            # the compiled step untouched)
+            bad = bool(jax.device_get(fetches["bad_step"]))
+            fetches["bad_step"] = bad
+            if bad:
+                self._consecutive_bad += 1
+                resilience_event(
+                    "bad_step_skip", step=hint if hint is not None else -1,
+                    consecutive=self._consecutive_bad, budget=budget)
+                if self._consecutive_bad >= budget:
+                    err = BadStepBudgetExceeded(
+                        budget, hint if hint is not None else -1)
+                    # the returned state is the last GOOD one (updates
+                    # were skipped in-graph); hand it to the rollback
+                    # path as the restore target
+                    err.state = new_ts
+                    raise err
+            else:
+                self._consecutive_bad = 0
+            if hint is not None:
+                _stamp_step(new_ts, hint if bad else hint + 1)
+        elif hint is not None:
             _stamp_step(new_ts, hint + 1)
         if FLAGS.get("check_nan_inf"):
             check_nan_inf(fetches, "train fetches")
         return new_ts, fetches
+
+    def reset_bad_steps(self) -> None:
+        """Zero the consecutive-bad-step counter (after a rollback)."""
+        self._consecutive_bad = 0
 
     def eval_step(self, ts: TrainState, batch):
         if self._state_shardings is None:

@@ -1,0 +1,33 @@
+"""Resilient training runtime: preemption, corruption, bad steps, retry.
+
+Wraps the trainer / checkpoint / distributed layers into a
+fault-tolerant loop (see RESILIENCE.md for the failure model, env
+knobs, exit codes and recovery semantics):
+
+- `RunSupervisor` / `train_resilient` — SIGTERM-safe supervision with
+  emergency checkpointing, a step watchdog and bad-step rollback.
+- `retry` — bounded exponential backoff + deterministic jitter, applied
+  to distributed init, checkpoint I/O and the commit barriers.
+- `chaos` — PTPU_CHAOS_* deterministic fault injection so every pillar
+  is testable in-process and in subprocess clusters.
+"""
+
+from paddle_tpu.resilience.errors import (
+    BadStepBudgetExceeded, PREEMPT_EXIT_CODE, ResilienceError,
+)
+from paddle_tpu.resilience.retry import (
+    RetryPolicy, backoff_delay, retry_call, with_retry,
+)
+from paddle_tpu.resilience import chaos
+
+
+def __getattr__(name):
+    # Lazy: supervisor sits ABOVE io.checkpoint in the layering, while
+    # io.checkpoint imports retry/chaos from this package — an eager
+    # supervisor import here would close that cycle before
+    # io.checkpoint finishes executing.
+    if name in ("RunSupervisor", "train_resilient"):
+        from paddle_tpu.resilience import supervisor
+        return getattr(supervisor, name)
+    raise AttributeError(
+        f"module paddle_tpu.resilience has no attribute {name}")

@@ -1,0 +1,177 @@
+"""Deterministic fault injection for the resilience layer.
+
+Sibling of the test suite's `PTPU_FAULT_PROC/STEP` hard-kill knob, but
+covering the failure modes a TPU fleet actually serves up, each behind
+a `PTPU_CHAOS_*` env var so both in-process tests and subprocess
+clusters can arm them without code changes:
+
+    PTPU_CHAOS_CKPT_IO=N        first N checkpoint shard writes raise OSError
+    PTPU_CHAOS_CKPT_READ=N      first N shard-file opens on load raise OSError
+    PTPU_CHAOS_BARRIER=N        first N checkpoint barrier waits raise
+    PTPU_CHAOS_INIT_FAIL=N      first N distributed-init attempts raise
+    PTPU_CHAOS_SIGTERM_STEP=S   SIGTERM self at the start of step S
+    PTPU_CHAOS_SIGTERM_PROC=P   ...only on process P (default: every process)
+    PTPU_CHAOS_NAN_STEP=S[:E]   poison batches at global steps S..E with NaN
+    PTPU_CHAOS_NAN_ATTEMPTS=K   ...for the first K attempts at each step (dflt 1)
+    PTPU_CHAOS_CORRUPT_STEP=S   corrupt ckpt-S right after it commits
+    PTPU_CHAOS_CORRUPT_MODE=M   truncate (default) | manifest
+
+Everything is deterministic: counters are plain per-process integers,
+no RNG — the same env produces the same fault schedule every run,
+which is what lets the chaos matrix assert bit-for-bit recovery.
+All hooks are no-ops (one dict lookup) when the env is unarmed.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from paddle_tpu.utils.log import resilience_event
+
+_SITES = {
+    "ckpt_write": "PTPU_CHAOS_CKPT_IO",
+    "ckpt_read": "PTPU_CHAOS_CKPT_READ",
+    "barrier": "PTPU_CHAOS_BARRIER",
+    "init_distributed": "PTPU_CHAOS_INIT_FAIL",
+}
+
+# site -> remaining injection budget (None until first read of the env)
+_budget: Dict[str, Optional[int]] = {}
+# global step -> remaining poisoned attempts
+_nan_left: Dict[int, int] = {}
+_sigterm_fired = False
+
+
+def reset() -> None:
+    """Forget all consumed budgets and re-read the env on next use."""
+    global _sigterm_fired
+    _budget.clear()
+    _nan_left.clear()
+    _sigterm_fired = False
+
+
+reload = reset  # alias: tests set os.environ then chaos.reload()
+
+
+def _int_env(var: str, default: int = 0) -> int:
+    try:
+        return int(os.environ.get(var, default))
+    except ValueError:
+        return default
+
+
+def maybe_fail(site: str) -> None:
+    """Raise an injected fault at `site` while its budget lasts."""
+    var = _SITES[site]
+    left = _budget.get(site)
+    if left is None:
+        left = _budget[site] = _int_env(var)
+    if left <= 0:
+        return
+    _budget[site] = left - 1
+    resilience_event("chaos_inject", site=site, remaining=left - 1)
+    exc = OSError if site.startswith("ckpt") else RuntimeError
+    raise exc(f"chaos: injected {site} failure ({var}, {left - 1} left)")
+
+
+def _proc_index() -> int:
+    env = os.environ.get("PTPU_PROCESS_ID")
+    if env is not None:
+        return int(env)
+    import jax
+    return jax.process_index()
+
+
+def maybe_sigterm(step: int) -> None:
+    """Deliver SIGTERM to this process at the start of `step` — the
+    spot-preemption simulation. Sleeps briefly after os.kill so the
+    handler (main thread) runs before the caller's next preemption
+    check: the emergency checkpoint then lands at a deterministic step."""
+    global _sigterm_fired
+    if _sigterm_fired:
+        return
+    at = _int_env("PTPU_CHAOS_SIGTERM_STEP", -1)
+    if at < 0 or step != at:
+        return
+    proc = _int_env("PTPU_CHAOS_SIGTERM_PROC", -1)
+    if proc >= 0 and _proc_index() != proc:
+        return
+    _sigterm_fired = True
+    resilience_event("chaos_inject", site="sigterm", step=step)
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(0.05)  # interrupted by the signal; handler has run after
+
+
+def _nan_window() -> Optional[Tuple[int, int]]:
+    spec = os.environ.get("PTPU_CHAOS_NAN_STEP")
+    if not spec:
+        return None
+    lo, _, hi = spec.partition(":")
+    return int(lo), int(hi) if hi else int(lo)
+
+
+def poison_batch(batch: Any, step: int) -> Any:
+    """Return `batch` with every float leaf multiplied by NaN while the
+    per-step attempt budget lasts (a bad-host simulation the bad-step
+    guard must absorb). Non-float leaves (labels) pass through."""
+    window = _nan_window()
+    if window is None or not (window[0] <= step <= window[1]):
+        return batch
+    left = _nan_left.get(step)
+    if left is None:
+        left = _nan_left[step] = _int_env("PTPU_CHAOS_NAN_ATTEMPTS", 1)
+    if left <= 0:
+        return batch
+    _nan_left[step] = left - 1
+    resilience_event("chaos_inject", site="nan", step=step,
+                     remaining=left - 1)
+    import jax.numpy as jnp
+
+    def nanify(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x * jnp.nan
+        return x
+    import jax
+    return jax.tree.map(nanify, batch)
+
+
+# -- checkpoint corruption (also used directly by tests + chaos_sweep) ------
+
+def corrupt_truncate_shard(path: str) -> str:
+    """Truncate the first shard .npz in checkpoint dir `path` to half —
+    a torn write on a shared FS. Returns the mangled file."""
+    names = sorted(n for n in os.listdir(path)
+                   if n.startswith("shards-p") and n.endswith(".npz"))
+    target = os.path.join(path, names[0])
+    size = os.path.getsize(target)
+    with open(target, "r+b") as f:
+        f.truncate(max(1, size // 2))
+    return target
+
+
+def corrupt_flip_manifest(path: str) -> str:
+    """Flip bytes in the middle of manifest.json — bit rot / partial
+    overwrite. Returns the mangled file."""
+    target = os.path.join(path, "manifest.json")
+    with open(target, "r+b") as f:
+        data = f.read()
+        mid = len(data) // 2
+        f.seek(mid)
+        f.write(bytes(b ^ 0xFF for b in data[mid:mid + 8]))
+    return target
+
+
+def maybe_corrupt_checkpoint(path: str, step: Optional[int]) -> None:
+    """Called by CheckpointManager right after a save commits: mangle
+    ckpt-{PTPU_CHAOS_CORRUPT_STEP} per PTPU_CHAOS_CORRUPT_MODE."""
+    at = _int_env("PTPU_CHAOS_CORRUPT_STEP", -1)
+    if at < 0 or step != at:
+        return
+    mode = os.environ.get("PTPU_CHAOS_CORRUPT_MODE", "truncate")
+    target = (corrupt_flip_manifest(path) if mode == "manifest"
+              else corrupt_truncate_shard(path))
+    resilience_event("chaos_inject", site="corrupt", step=step,
+                     mode=mode, file=os.path.basename(target))

@@ -1,0 +1,36 @@
+"""Exception types + exit-code contract for the resilience layer.
+
+Import-light on purpose: `parallel.trainer` (the bad-step guard) and
+`io.checkpoint` both raise these without pulling the rest of the
+resilience package — no heavy imports, no cycles.
+"""
+
+from __future__ import annotations
+
+# Exit code a preempted run terminates with after its emergency
+# checkpoint commits. Distinct from 0 (clean), 1 (crash), 17 (the test
+# suite's simulated hard-kill) and the shell's 128+SIGTERM=143 (a
+# process that died WITHOUT managing an emergency save) — a scheduler
+# or the launcher can tell "preempted, checkpoint intact, safe to
+# reschedule" from "failed" by this code alone.
+PREEMPT_EXIT_CODE = 75  # EX_TEMPFAIL: transient, retry the job
+
+
+class ResilienceError(RuntimeError):
+    """Base class for resilience-layer failures."""
+
+
+class BadStepBudgetExceeded(ResilienceError):
+    """Raised by the bad-step guard after `bad_step_budget` consecutive
+    non-finite steps: the state is still the last good one (every bad
+    update was skipped in-graph), but the run needs a rollback to the
+    last good checkpoint — the in-memory state may sit in a region that
+    keeps producing NaNs (bad host, poisoned batch stream)."""
+
+    def __init__(self, budget: int, step: int):
+        super().__init__(
+            f"{budget} consecutive non-finite steps at step {step}; "
+            "state unchanged (updates skipped), roll back to the last "
+            "good checkpoint")
+        self.budget = budget
+        self.step = step

@@ -1,0 +1,103 @@
+"""Bounded retry with exponential backoff + deterministic jitter.
+
+The transient-failure policy for every control-plane edge the runtime
+crosses: `parallel.distributed.init_distributed` (slice flaps at
+rendezvous), checkpoint shard I/O (shared-FS hiccups), and the
+checkpoint `_barrier` RPC. The reference's analog is its RPC deadline +
+re-send story (grpc retry loops around pserver calls); here it is one
+policy object so every site logs the same `retry` event and tests can
+drive it via env knobs.
+
+Jitter is DETERMINISTIC — hash of (name, attempt), not a live RNG — so
+a restarted run and its uninterrupted twin sleep identically and
+subprocess tests stay reproducible. Sleeps scale by `PTPU_RETRY_SCALE`
+(set it to 0 in tests to make retries instantaneous).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type
+
+from paddle_tpu.utils.log import resilience_event
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """attempts = TOTAL tries (1 == no retry). Delay before try k (k>=2)
+    is min(base * 2**(k-2), max_delay) * (1 + jitter_frac * u) with u in
+    [0, 1) derived from crc32((name, attempt))."""
+    attempts: int = 3
+    base_delay: float = 0.25
+    max_delay: float = 8.0
+    jitter_frac: float = 0.25
+    retry_on: Tuple[Type[BaseException], ...] = (OSError, RuntimeError)
+    # a matching exception is NOT retried even with budget left (e.g. a
+    # barrier DEADLINE_EXCEEDED: peers have moved on, re-waiting the
+    # same key can only hang again)
+    giveup: Optional[Callable[[BaseException], bool]] = None
+
+
+def _jitter_u(name: str, attempt: int) -> float:
+    return (zlib.crc32(f"{name}:{attempt}".encode()) % 1000) / 1000.0
+
+
+def _scale() -> float:
+    try:
+        return float(os.environ.get("PTPU_RETRY_SCALE", "1"))
+    except ValueError:
+        return 1.0
+
+
+def backoff_delay(policy: RetryPolicy, name: str, attempt: int) -> float:
+    """Delay (s) before `attempt` (2-based; attempt 1 never waits)."""
+    if attempt <= 1:
+        return 0.0
+    raw = min(policy.base_delay * (2.0 ** (attempt - 2)), policy.max_delay)
+    return raw * (1.0 + policy.jitter_frac * _jitter_u(name, attempt)) \
+        * _scale()
+
+
+def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
+               name: Optional[str] = None, **kwargs):
+    """Call fn(*args, **kwargs) under `policy`, emitting one `retry`
+    event per re-attempt. Re-raises the last exception when the budget
+    is exhausted (or immediately on a non-retryable/giveup error)."""
+    policy = policy or RetryPolicy()
+    name = name or getattr(fn, "__name__", "call")
+    last: Optional[BaseException] = None
+    for attempt in range(1, max(1, policy.attempts) + 1):
+        delay = backoff_delay(policy, name, attempt)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            last = e
+            if policy.giveup is not None and policy.giveup(e):
+                raise
+            if attempt >= max(1, policy.attempts):
+                raise
+            resilience_event("retry", site=name, attempt=attempt,
+                             of=policy.attempts,
+                             next_delay_s=round(
+                                 backoff_delay(policy, name, attempt + 1), 3),
+                             error=f"{type(e).__name__}: {e}")
+    raise last  # unreachable; keeps type checkers honest
+
+
+def with_retry(policy: Optional[RetryPolicy] = None,
+               name: Optional[str] = None):
+    """Decorator form of retry_call."""
+
+    def deco(fn: Callable):
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, policy=policy,
+                              name=name or fn.__name__, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+    return deco

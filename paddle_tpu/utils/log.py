@@ -2,10 +2,17 @@
 
 Analog of the reference's glog `VLOG(n)` + InitGLOG (platform/init.cc:165)
 and pretty_log (string/pretty_log.h). Verbosity from FLAGS_v / GLOG_v env.
+
+Also hosts the `resilience` event stream: single-line JSON records on
+STDOUT (`{"evt": "preempt", ...}`) so subprocess cluster tests — which
+only see a worker's captured stdout — can assert on recovery behavior
+(preemption, checkpoint rejection, bad-step skips, rollbacks, retries)
+without any side channel.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -38,6 +45,42 @@ def warning(msg: str, *args) -> None:
 
 def error(msg: str, *args) -> None:
     _LOGGER.error(msg, *args)
+
+
+# -- resilience event stream ------------------------------------------------
+
+class _StdoutHandler(logging.Handler):
+    """Writes to whatever sys.stdout is AT EMIT TIME (not at import):
+    pytest's capsys and subprocess pipes both swap sys.stdout, and a
+    handler bound to the import-time stream would bypass them."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            stream = sys.stdout
+            stream.write(record.getMessage() + "\n")
+            stream.flush()
+        except Exception:
+            pass  # logging must never take the run down
+
+
+_RESILIENCE = logging.getLogger("paddle_tpu.resilience")
+if not _RESILIENCE.handlers:
+    _RESILIENCE.addHandler(_StdoutHandler())
+    _RESILIENCE.setLevel(logging.INFO)
+    _RESILIENCE.propagate = False
+
+
+def resilience_event(evt: str, **fields) -> dict:
+    """Emit one single-line JSON record on stdout and return it.
+
+    Canonical events: `preempt`, `ckpt_reject`, `bad_step_skip`,
+    `rollback`, `retry`, `chaos_inject`, `hang`. "evt" sorts first so a
+    grep for '{"evt": "rollback"' works; non-JSON-native values go
+    through str().
+    """
+    rec = {"evt": evt, **fields}
+    _RESILIENCE.info(json.dumps(rec, sort_keys=False, default=str))
+    return rec
 
 
 class scoped_timer:

@@ -1,16 +1,28 @@
-"""Worker for the fault-injection + elastic-restart test.
+"""Worker for the fault-injection + elastic-restart tests.
 
 Reference pattern: test_dist_base.py:341 subprocess clusters — extended
-per SURVEY §5.3 with the fault-injection knob the reference lacks:
-PTPU_FAULT_PROC/PTPU_FAULT_STEP make that process die (os._exit) at the
-start of that step, mid-run. Recovery is checkpoint/resume: every step is
-checkpointed via CheckpointManager; on start the worker restores the
-latest checkpoint and continues. Batches are keyed by global step, so an
-interrupted + restarted run reproduces the uninterrupted loss curve
-exactly.
+per SURVEY §5.3 with the fault knobs the reference lacks. The loop runs
+under the resilience runtime (`train_resilient` + `RunSupervisor`), so
+every injected failure exercises the real recovery path:
+
+    PTPU_FAULT_PROC/PTPU_FAULT_STEP   hard crash (os._exit 17) mid-run
+    PTPU_CHAOS_SIGTERM_STEP           preemption: emergency checkpoint,
+                                      exit PREEMPT_EXIT_CODE
+    PTPU_CHAOS_NAN_STEP[/ATTEMPTS]    poisoned batches; the bad-step
+                                      guard (PTPU_BAD_STEP_BUDGET) skips
+                                      or rolls back
+    PTPU_CHAOS_CORRUPT_STEP/MODE      checkpoint torn after commit;
+                                      restore falls back to an intact one
+
+Recovery is checkpoint/resume: the worker checkpoints every
+PTPU_SAVE_EVERY steps via CheckpointManager; on start it restores the
+newest INTACT checkpoint and continues. Batches are keyed by global
+step, so an interrupted + restarted (or rolled-back) run reproduces the
+uninterrupted loss curve exactly.
 
 Prints ONE json line: {"proc", "start_step", "steps": [...], "losses":
-[...]}.
+[...]} (resilience events appear as earlier single-line JSON records
+with an "evt" key).
 """
 
 import json
@@ -33,9 +45,12 @@ def main():
     from paddle_tpu.models import MLP
     from paddle_tpu.ops import functional as F
     from paddle_tpu.optim.optimizer import Adam
-    from paddle_tpu.parallel import MeshConfig, MeshTrainer, make_mesh
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, make_mesh)
     from paddle_tpu.parallel.distributed import (
         init_distributed, process_index)
+    from paddle_tpu.resilience.supervisor import (
+        RunSupervisor, train_resilient)
 
     init_distributed()
     proc = process_index()
@@ -45,18 +60,22 @@ def main():
     total_steps = int(os.environ.get("PTPU_TOTAL_STEPS", "6"))
     fault_proc = int(os.environ.get("PTPU_FAULT_PROC", "-1"))
     fault_step = int(os.environ.get("PTPU_FAULT_STEP", "-1"))
+    save_every = int(os.environ.get("PTPU_SAVE_EVERY", "1"))
+    budget = int(os.environ.get("PTPU_BAD_STEP_BUDGET", "0"))
 
     mesh = make_mesh(MeshConfig(dp=ndev))
     model = MLP(hidden=(16,), num_classes=4)
     loss_fn = supervised_loss(
         lambda lg, y: F.softmax_with_cross_entropy(lg, y),
         metrics={"acc": accuracy})
-    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh)
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh,
+                          strategy=DistStrategy(
+                              bad_step_budget=budget or None))
 
     gbs = 4 * ndev
     ts = trainer.init_state(jnp.zeros((gbs, 6)))
     mgr = CheckpointManager(
-        ckpt_dir, max_to_keep=2,
+        ckpt_dir, max_to_keep=int(os.environ.get("PTPU_MAX_TO_KEEP", "2")),
         async_save=bool(int(os.environ.get("PTPU_ASYNC_CKPT", "0"))))
     restored, start_step = mgr.restore_latest(ts)
     if restored is not None:
@@ -68,6 +87,9 @@ def main():
     bsh = NamedSharding(mesh, P("dp"))
 
     def batch_for(step):
+        if proc == fault_proc and step == fault_step:
+            # simulated hard crash: no cleanup, no checkpoint, no goodbye
+            os._exit(17)
         rs = np.random.RandomState(1000 + step)     # keyed by global step
         gx = rs.randn(gbs, 6).astype(np.float32)
         gy = rs.randint(0, 4, gbs).astype(np.int64)
@@ -78,16 +100,16 @@ def main():
         return x, y
 
     steps, losses = [], []
-    for step in range(start_step, total_steps):
-        if proc == fault_proc and step == fault_step:
-            # simulated hard crash: no cleanup, no checkpoint, no goodbye
-            os._exit(17)
-        ts, fetches = trainer.train_step(ts, batch_for(step),
-                                         rng=jax.random.key(step))
+
+    def on_step(step, fetches):
         steps.append(step)
         losses.append(float(fetches["loss"]))
-        mgr.save(ts, step=step + 1)
-    mgr.wait()   # drain an in-flight async save before exiting
+
+    with RunSupervisor(mgr) as sup:
+        ts = train_resilient(
+            trainer, ts, batch_for, total_steps, mgr,
+            start_step=start_step, save_every=save_every, supervisor=sup,
+            rng_for_step=lambda s: jax.random.key(s), on_step=on_step)
 
     print(json.dumps({"proc": proc, "start_step": start_step,
                       "steps": steps, "losses": losses}))

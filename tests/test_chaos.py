@@ -1,0 +1,178 @@
+"""Fast chaos cells (tier-1, `chaos` marker): the in-process slice of
+the chaos matrix. Each test arms PTPU_CHAOS_* knobs and asserts the
+acceptance property — training completes AND the loss curve matches the
+fault-free run bit-for-bit (fault schedules are deterministic, batches
+are keyed by global step, the step only advances on finite updates).
+
+The full grid (subprocess clusters, SIGTERM across processes, torn
+checkpoints between runs) lives in tools/chaos_sweep.py and
+test_distributed.py."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io.checkpoint import (
+    CheckpointManager, checkpoint_step, latest_checkpoint, list_checkpoints)
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
+from paddle_tpu.resilience.supervisor import RunSupervisor, train_resilient
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.setenv("PTPU_RETRY_SCALE", "0")
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _make(budget=None):
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, make_mesh)
+
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    model = MLP(hidden=(8,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y))
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh,
+                          strategy=DistStrategy(bad_step_budget=budget))
+    ts = trainer.init_state(jnp.zeros((16, 6)))
+    return trainer, ts
+
+
+def _batch_for(step):
+    rs = np.random.RandomState(1000 + step)
+    x = jnp.asarray(rs.randn(16, 6).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 4, 16).astype(np.int64))
+    return x, y
+
+
+def _run(tmp, steps=6, budget=None, save_every=1, start=None, ts=None,
+         trainer=None, **mgr_kw):
+    """One train_resilient run; returns (losses_by_step, final_ts)."""
+    if trainer is None:
+        trainer, ts = _make(budget)
+    mgr = CheckpointManager(str(tmp), max_to_keep=mgr_kw.pop("keep", 10))
+    if start is None:
+        restored, start = mgr.restore_latest(ts)
+        if restored is not None:
+            ts = restored
+        else:
+            start = 0
+    losses = {}
+    ts = train_resilient(
+        trainer, ts, _batch_for, steps, mgr, start_step=start,
+        save_every=save_every,
+        on_step=lambda s, f: losses.__setitem__(s, float(f["loss"])))
+    return losses, ts
+
+
+def test_nan_burst_is_absorbed_bit_for_bit(tmp_path, monkeypatch):
+    """Acceptance cell: a 2-step NaN burst. Each poisoned attempt is
+    skipped in-graph and the same global step retries with the clean
+    batch — the final curve equals the fault-free run exactly."""
+    clean, _ = _run(tmp_path / "clean", budget=3)
+
+    monkeypatch.setenv("PTPU_CHAOS_NAN_STEP", "2:3")   # burst at steps 2-3
+    chaos.reload()
+    chaotic, _ = _run(tmp_path / "chaos", budget=3)
+
+    assert chaotic == clean                            # bit-for-bit
+
+
+def test_nan_budget_blown_rolls_back_then_completes(tmp_path, monkeypatch,
+                                                    capsys):
+    """Three consecutive poisoned attempts against a budget of 2: the
+    guard raises, train_resilient restores the newest checkpoint, the
+    counter resets, the remaining attempt is absorbed as a plain skip
+    and training still converges to the fault-free curve."""
+    clean, _ = _run(tmp_path / "clean", budget=2)
+
+    monkeypatch.setenv("PTPU_CHAOS_NAN_STEP", "3")
+    monkeypatch.setenv("PTPU_CHAOS_NAN_ATTEMPTS", "3")
+    chaos.reload()
+    chaotic, _ = _run(tmp_path / "chaos", budget=2)
+
+    out = capsys.readouterr().out
+    evts = [json.loads(l) for l in out.splitlines() if l.startswith('{"evt"')]
+    rb = [e for e in evts if e["evt"] == "rollback"]
+    assert len(rb) == 1 and rb[0]["from_step"] == 3 and rb[0]["to_step"] == 3
+    assert sum(e["evt"] == "bad_step_skip" for e in evts) == 3
+    assert chaotic == clean
+
+
+@pytest.mark.parametrize("mode", ["truncate", "manifest"])
+def test_corrupted_latest_checkpoint_falls_back(tmp_path, monkeypatch, mode):
+    """Acceptance cell: the newest checkpoint is torn right after it
+    commits; a later restore must fall back to the newest INTACT one
+    instead of aborting."""
+    monkeypatch.setenv("PTPU_CHAOS_CORRUPT_STEP", "6")   # the final save
+    monkeypatch.setenv("PTPU_CHAOS_CORRUPT_MODE", mode)
+    chaos.reload()
+    losses, ts = _run(tmp_path, steps=6, budget=None)
+    assert sorted(losses) == list(range(6))              # run completed
+
+    chaos.reset()
+    monkeypatch.delenv("PTPU_CHAOS_CORRUPT_STEP")
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=10)
+    _, trainer_ts = _make()
+    restored, step = mgr.restore_latest(trainer_ts)
+    assert step == 5                                     # newest intact
+    assert restored is not None
+
+
+def test_sigterm_preemption_in_process(tmp_path, monkeypatch):
+    """Acceptance cell: SIGTERM at step 2 → emergency checkpoint at the
+    step boundary, preemption exit code; a restart resumes at step 2 and
+    the stitched curve equals the uninterrupted run."""
+    clean, _ = _run(tmp_path / "clean", steps=6)
+
+    monkeypatch.setenv("PTPU_CHAOS_SIGTERM_STEP", "2")
+    chaos.reload()
+
+    def _exit(code):
+        raise SystemExit(code)
+
+    trainer, ts = _make()
+    mgr = CheckpointManager(str(tmp_path / "chaos"), max_to_keep=10)
+    losses = {}
+    sup = RunSupervisor(mgr, _exit_fn=_exit)
+    with pytest.raises(SystemExit) as e, sup:
+        train_resilient(trainer, ts, _batch_for, 6, mgr, start_step=0,
+                        supervisor=sup,
+                        on_step=lambda s, f: losses.__setitem__(
+                            s, float(f["loss"])))
+    assert e.value.code == PREEMPT_EXIT_CODE
+    assert sorted(losses) == [0, 1]
+    assert checkpoint_step(latest_checkpoint(str(tmp_path / "chaos"))) == 2
+
+    # restart: no chaos; resumes from the emergency checkpoint
+    chaos.reset()
+    monkeypatch.delenv("PTPU_CHAOS_SIGTERM_STEP")
+    resumed, _ = _run(tmp_path / "chaos", steps=6)
+    assert sorted(resumed) == [2, 3, 4, 5]
+    assert {**losses, **resumed} == clean
+
+
+def test_transient_ckpt_io_faults_absorbed_by_retry(tmp_path, monkeypatch):
+    """Two injected shard-write failures: the save-side retry absorbs
+    them; every committed checkpoint verifies intact afterwards."""
+    monkeypatch.setenv("PTPU_CHAOS_CKPT_IO", "2")
+    chaos.reload()
+    losses, _ = _run(tmp_path, steps=3)
+    assert sorted(losses) == [0, 1, 2]
+    from paddle_tpu.io.checkpoint import verify_checkpoint
+    ckpts = list_checkpoints(str(tmp_path))
+    assert [s for s, _ in ckpts] == [3, 2, 1]
+    for _, path in ckpts:
+        verify_checkpoint(path)

@@ -1,0 +1,414 @@
+"""In-process tests for the resilience layer: retry policy, checkpoint
+integrity + corrupt-fallback, stale-marker hygiene, bad-step guard,
+supervisor signal/watchdog plumbing, structured recovery events.
+
+Subprocess-cluster coverage (SIGTERM preemption, peer death) lives in
+test_distributed.py; chaos-marker fast cells in test_chaos.py."""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io.checkpoint import (
+    CheckpointIntegrityError, CheckpointManager, checkpoint_step,
+    latest_checkpoint, list_checkpoints, load_checkpoint, save_checkpoint,
+    verify_checkpoint)
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.errors import BadStepBudgetExceeded
+from paddle_tpu.resilience.retry import (
+    RetryPolicy, backoff_delay, retry_call)
+from paddle_tpu.resilience.supervisor import RunSupervisor
+from paddle_tpu.utils.log import resilience_event
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.setenv("PTPU_RETRY_SCALE", "0")   # instantaneous retries
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _events(capsys, evt=None):
+    out = capsys.readouterr().out
+    recs = [json.loads(l) for l in out.splitlines()
+            if l.startswith('{"evt"')]
+    return [r for r in recs if evt is None or r["evt"] == evt]
+
+
+# -- retry ------------------------------------------------------------------
+
+def test_retry_succeeds_after_transient_failures(capsys):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    policy = RetryPolicy(attempts=3, retry_on=(OSError,))
+    assert retry_call(flaky, policy=policy, name="t") == "ok"
+    assert len(calls) == 3
+    evts = _events(capsys, "retry")
+    assert [e["attempt"] for e in evts] == [1, 2]
+    assert all(e["site"] == "t" for e in evts)
+
+
+def test_retry_budget_exhausted_reraises():
+    def always():
+        raise OSError("down")
+    with pytest.raises(OSError, match="down"):
+        retry_call(always, policy=RetryPolicy(attempts=2), name="t")
+
+
+def test_retry_giveup_short_circuits():
+    calls = []
+
+    def deadline():
+        calls.append(1)
+        raise RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+
+    policy = RetryPolicy(attempts=5, retry_on=(RuntimeError,),
+                         giveup=lambda e: "deadline" in str(e).lower())
+    with pytest.raises(RuntimeError):
+        retry_call(deadline, policy=policy, name="b")
+    assert len(calls) == 1
+
+
+def test_retry_nonretryable_type_raises_immediately():
+    calls = []
+
+    def typed():
+        calls.append(1)
+        raise ValueError("not transient")
+    with pytest.raises(ValueError):
+        retry_call(typed, policy=RetryPolicy(attempts=5,
+                                             retry_on=(OSError,)))
+    assert len(calls) == 1
+
+
+def test_backoff_is_deterministic_and_bounded(monkeypatch):
+    monkeypatch.setenv("PTPU_RETRY_SCALE", "1")   # real delays for this one
+    p = RetryPolicy(attempts=8, base_delay=0.25, max_delay=2.0)
+    d = [backoff_delay(p, "site", k) for k in range(1, 8)]
+    assert d[0] == 0.0                       # first try never waits
+    assert d == [backoff_delay(p, "site", k) for k in range(1, 8)]
+    assert all(x <= 2.0 * 1.25 for x in d)   # max_delay * (1 + jitter)
+    assert backoff_delay(p, "site", 3) != backoff_delay(p, "other", 3)
+
+
+# -- checkpoint integrity ---------------------------------------------------
+
+def _tree(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"w": rs.randn(8, 4).astype(np.float32),
+            "b": rs.randn(4).astype(np.float32)}
+
+
+def test_manifest_records_per_shard_checksums(tmp_path):
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, _tree(), step=7)
+    manifest = verify_checkpoint(path)
+    assert manifest["step"] == 7
+    files = manifest["files"]
+    assert "shards-p0.npz" in files and "shard_index-p0.json" in files
+    for meta in files.values():
+        assert meta["bytes"] > 0 and isinstance(meta["crc32"], int)
+    assert checkpoint_step(path) == 7
+
+
+def test_truncated_shard_fails_verify_and_load(tmp_path):
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, _tree(), step=1)
+    chaos.corrupt_truncate_shard(path)
+    with pytest.raises(CheckpointIntegrityError, match="corrupt"):
+        verify_checkpoint(path)
+    with pytest.raises(CheckpointIntegrityError, match="corrupt"):
+        load_checkpoint(path, _tree())
+
+
+def test_flipped_manifest_fails_verify(tmp_path):
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, _tree(), step=1)
+    chaos.corrupt_flip_manifest(path)
+    with pytest.raises(CheckpointIntegrityError, match="manifest"):
+        verify_checkpoint(path)
+
+
+def test_restore_latest_falls_back_to_newest_intact(tmp_path, capsys):
+    """Satellite: truncate one shard in the newest checkpoint and flip
+    manifest bytes in the next; restore_latest returns the newest INTACT
+    step and logs which checkpoints were rejected and why."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    trees = {s: _tree(s) for s in (1, 2, 3)}
+    for s in (1, 2, 3):
+        mgr.save(trees[s], step=s)
+    chaos.corrupt_truncate_shard(str(tmp_path / "ckpt-3"))
+    chaos.corrupt_flip_manifest(str(tmp_path / "ckpt-2"))
+
+    restored, step = mgr.restore_latest(_tree(99))
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"], trees[1]["w"])
+
+    rejects = _events(capsys, "ckpt_reject")
+    assert [r["ckpt"] for r in rejects] == ["ckpt-3", "ckpt-2"]
+    assert "corrupt" in rejects[0]["reason"]
+    assert "JSON" in rejects[1]["reason"] or "manifest" in rejects[1]["reason"]
+
+
+def test_restore_latest_none_when_all_corrupt(tmp_path, capsys):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    mgr.save(_tree(), step=1)
+    chaos.corrupt_truncate_shard(str(tmp_path / "ckpt-1"))
+    restored, step = mgr.restore_latest(_tree())
+    assert restored is None and step is None
+    assert len(_events(capsys, "ckpt_reject")) == 1
+
+
+def test_latest_checkpoint_skips_ptmp_and_manifestless(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    mgr.save(_tree(), step=2)
+    # an uncommitted staging dir from a crashed save and a torn dir
+    # whose manifest never landed: neither is offered for restore
+    os.makedirs(str(tmp_path / "ckpt-9.ptmp"))
+    os.makedirs(str(tmp_path / "ckpt-8"))
+    assert latest_checkpoint(str(tmp_path)).endswith("ckpt-2")
+    assert [s for s, _ in list_checkpoints(str(tmp_path))] == [2]
+
+
+def test_manager_init_clears_stale_failure_markers(tmp_path):
+    """Satellite: a failure marker left by a previous crashed run must
+    not poison this run's first save to the same path."""
+    marker = tmp_path / "ckpt-5.err-p1"
+    marker.write_text("OSError: disk full (from a previous life)")
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    assert not marker.exists()
+    mgr.save(_tree(), step=5)     # would raise on a stale marker check
+    restored, step = mgr.restore_latest(_tree())
+    assert step == 5
+
+
+def test_version1_checkpoint_still_loads(tmp_path):
+    """Read-compat: v1 single-npz checkpoints predate checksums and
+    must keep loading (and verifying on existence alone)."""
+    path = tmp_path / "v1"
+    path.mkdir()
+    tree = _tree()
+    np.savez(str(path / "arrays.npz"),
+             **{f"a{i}": v for i, v in enumerate([tree["b"], tree["w"]])})
+    leaves = [{"key": "b", "shape": [4], "dtype": "float32", "slot": "a0"},
+              {"key": "w", "shape": [8, 4], "dtype": "float32",
+               "slot": "a1"}]
+    with open(str(path / "manifest.json"), "w") as f:
+        json.dump({"version": 1, "step": 3, "leaves": leaves}, f)
+    verify_checkpoint(str(path))
+    out = load_checkpoint(str(path))
+    np.testing.assert_array_equal(out["w"], tree["w"])
+
+
+def test_ckpt_write_retry_under_injected_io_errors(tmp_path, monkeypatch,
+                                                   capsys):
+    monkeypatch.setenv("PTPU_CHAOS_CKPT_IO", "2")
+    chaos.reload()
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, _tree(), step=1)      # retries absorb 2 faults
+    verify_checkpoint(path)
+    assert len(_events(capsys, "retry")) == 2
+
+
+def test_ckpt_read_retry_under_injected_io_errors(tmp_path, monkeypatch,
+                                                  capsys):
+    path = str(tmp_path / "ck")
+    save_checkpoint(path, _tree(), step=1)
+    monkeypatch.setenv("PTPU_CHAOS_CKPT_READ", "1")
+    chaos.reload()
+    out = load_checkpoint(path, _tree())
+    np.testing.assert_array_equal(out["w"], _tree()["w"])
+    assert len(_events(capsys, "retry")) == 1
+
+
+# -- bad-step guard ---------------------------------------------------------
+
+def _mesh_trainer(budget):
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, make_mesh)
+
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    model = MLP(hidden=(8,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y))
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh,
+                          strategy=DistStrategy(bad_step_budget=budget))
+    ts = trainer.init_state(jnp.zeros((16, 6)))
+    return trainer, ts
+
+
+def _batch(step, poison=False):
+    rs = np.random.RandomState(100 + step)
+    x = rs.randn(16, 6).astype(np.float32)
+    if poison:
+        x = x * np.nan
+    y = rs.randint(0, 4, 16).astype(np.int64)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_bad_step_skips_update_and_reports(capsys):
+    trainer, ts = _mesh_trainer(budget=3)
+    ts, f0 = trainer.train_step(ts, _batch(0), rng=jax.random.key(0))
+    assert f0["bad_step"] is False
+    before = jax.device_get(ts.params)
+    step_before = int(jax.device_get(ts.step))
+
+    ts, f1 = trainer.train_step(ts, _batch(1, poison=True),
+                                rng=jax.random.key(1))
+    assert f1["bad_step"] is True
+    after = jax.device_get(ts.params)
+    for b, a in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(b, a)       # true no-op
+    assert int(jax.device_get(ts.step)) == step_before
+    evts = _events(capsys, "bad_step_skip")
+    assert len(evts) == 1 and evts[0]["consecutive"] == 1
+
+    # a good step afterwards resets the consecutive counter
+    ts, f2 = trainer.train_step(ts, _batch(1), rng=jax.random.key(1))
+    assert f2["bad_step"] is False
+    assert trainer._consecutive_bad == 0
+
+
+def test_bad_step_budget_exceeded_raises_with_state():
+    trainer, ts = _mesh_trainer(budget=2)
+    ts, _ = trainer.train_step(ts, _batch(0), rng=jax.random.key(0))
+    good = jax.device_get(ts.params)
+    ts, f = trainer.train_step(ts, _batch(1, poison=True),
+                               rng=jax.random.key(1))
+    assert f["bad_step"] is True
+    with pytest.raises(BadStepBudgetExceeded) as e:
+        trainer.train_step(ts, _batch(1, poison=True),
+                           rng=jax.random.key(1))
+    # the carried state is still the last good one
+    carried = jax.device_get(e.value.state.params)
+    for g, c in zip(jax.tree.leaves(good), jax.tree.leaves(carried)):
+        np.testing.assert_array_equal(g, c)
+    trainer.reset_bad_steps()
+    assert trainer._consecutive_bad == 0
+
+
+def test_guard_does_not_perturb_clean_training():
+    """Guard on vs off over identical clean batches: identical losses
+    (the isfinite select is a no-op on finite steps)."""
+    t_on, ts_on = _mesh_trainer(budget=3)
+    t_off, ts_off = _mesh_trainer(budget=None)
+    for s in range(3):
+        ts_on, f_on = t_on.train_step(ts_on, _batch(s),
+                                      rng=jax.random.key(s))
+        ts_off, f_off = t_off.train_step(ts_off, _batch(s),
+                                         rng=jax.random.key(s))
+        np.testing.assert_allclose(float(f_on["loss"]),
+                                   float(f_off["loss"]), rtol=1e-6)
+
+
+# -- supervisor -------------------------------------------------------------
+
+def test_supervisor_defers_signal_and_emergency_checkpoints(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    exits = []
+    sup = RunSupervisor(mgr, _exit_fn=exits.append)
+    tree = _tree()
+    with sup:
+        os.kill(os.getpid(), signal.SIGINT)
+        import time
+        time.sleep(0.05)                     # let the handler run
+        assert sup.preempted == signal.SIGINT
+        sup.maybe_preempt_exit(tree, step=4)
+    assert exits == [sup.exit_code]
+    assert checkpoint_step(latest_checkpoint(str(tmp_path))) == 4
+    restored, step = mgr.restore_latest(_tree())
+    assert step == 4
+    np.testing.assert_array_equal(restored["w"], tree["w"])
+
+
+def test_supervisor_skips_emergency_save_when_step_committed(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(_tree(), step=4)
+    exits = []
+    sup = RunSupervisor(mgr, _exit_fn=exits.append)
+    with sup:
+        os.kill(os.getpid(), signal.SIGINT)
+        import time
+        time.sleep(0.05)
+        sup.maybe_preempt_exit(_tree(1), step=4)
+    assert exits == [sup.exit_code]
+    # the pre-existing ckpt-4 was kept, not overwritten with _tree(1)
+    restored, _ = mgr.restore_latest(_tree())
+    np.testing.assert_array_equal(restored["w"], _tree()["w"])
+
+
+def test_supervisor_restores_handlers_on_exit():
+    before = signal.getsignal(signal.SIGTERM)
+    with RunSupervisor(None):
+        assert signal.getsignal(signal.SIGTERM) != before
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
+def test_watchdog_flags_hung_step(capsys):
+    import time
+    sup = RunSupervisor(None, watchdog_timeout_s=0.1)
+    with sup:
+        with sup.watch_step(7):
+            time.sleep(0.4)
+    assert 7 in sup.hung_steps
+    evts = _events(capsys, "hang")
+    assert evts and evts[0]["step"] == 7
+
+
+def test_preempt_without_signal_is_noop(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    exits = []
+    sup = RunSupervisor(mgr, _exit_fn=exits.append)
+    with sup:
+        sup.maybe_preempt_exit(_tree(), step=1)
+    assert exits == [] and latest_checkpoint(str(tmp_path)) is None
+
+
+# -- distributed init retry -------------------------------------------------
+
+def test_init_distributed_retries_rendezvous(monkeypatch, capsys):
+    from paddle_tpu.parallel import distributed
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setenv("PTPU_CHAOS_INIT_FAIL", "2")
+    monkeypatch.setenv("PTPU_INIT_RETRIES", "3")
+    chaos.reload()
+    old = distributed._initialized
+    distributed._initialized = False
+    try:
+        distributed.init_distributed(coordinator="127.0.0.1:1",
+                                     num_processes=1, process_id=0)
+        assert len(calls) == 1               # 2 injected faults absorbed
+        assert len(_events(capsys, "retry")) == 2
+    finally:
+        distributed._initialized = old
+
+
+# -- event stream -----------------------------------------------------------
+
+def test_resilience_event_is_single_line_json(capsys):
+    rec = resilience_event("rollback", from_step=9, to_step=6)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    parsed = json.loads(out[0])
+    assert parsed == {"evt": "rollback", "from_step": 9, "to_step": 6}
+    assert rec["evt"] == "rollback"
+    assert out[0].startswith('{"evt": "rollback"')

@@ -1,0 +1,239 @@
+"""Chaos matrix for the resilience runtime (RESILIENCE.md).
+
+Sweeps a grid of injected faults over the 2-process elastic cluster
+(tests/elastic_worker.py via parallel.launch) and, where the platform
+cannot run multi-process CPU jobs, over the in-process single-host
+loop. Each cell runs train-to-fault, restart-to-completion, and a
+fault-free twin, then checks the acceptance property: the stitched loss
+curve equals the fault-free curve bit-for-bit and the run never aborts
+while an intact checkpoint exists.
+
+One JSON line per cell on stdout:
+
+    {"cell": "sigterm@4", "mode": "cluster", "ok": true, ...}
+
+Exit code: 0 iff every cell is ok. The fast in-process subset of this
+grid runs in tier-1 as tests/test_chaos.py (`chaos` marker).
+
+Run: python tools/chaos_sweep.py [--steps 8] [--inprocess-only]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import _bootstrap  # noqa: F401  (repo path + cpu override)
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ELASTIC = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+# -- cluster cells -----------------------------------------------------------
+
+def _cluster_env(extra):
+    env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "PTPU_RETRY_SCALE": "0.01"}
+    env.update(extra)
+    return env
+
+
+def _cluster_run(ckpt, steps, extra=None, expect_rc=None):
+    """Launch the 2-proc elastic worker; returns (outs, err_msg)."""
+    from paddle_tpu.parallel.launch import launch
+    env = _cluster_env({"PTPU_CKPT_DIR": ckpt, "PTPU_TOTAL_STEPS": str(steps),
+                        **(extra or {})})
+    try:
+        results = launch(2, [sys.executable, ELASTIC],
+                         cpu_devices_per_proc=2, env=env, timeout=240,
+                         peer_failure_grace=5.0)
+    except RuntimeError as e:
+        return None, str(e)
+    outs = []
+    for r in results:
+        line = [l for l in r.stdout.splitlines()
+                if l.startswith("{") and '"evt"' not in l][-1]
+        outs.append(json.loads(line))
+    return outs, None
+
+
+def _losses_by_step(out):
+    return dict(zip(out["steps"], out["losses"]))
+
+
+def _cluster_cell(name, tmp, steps, fault_env, fault_rc, clean_curve):
+    """Run fault → restart → compare; returns the verdict dict."""
+    ckpt = os.path.join(tmp, name.replace("@", "-").replace(":", "-"))
+    detail = {}
+    # leg 1: run with the fault armed (may die with fault_rc, may finish)
+    outs, err = _cluster_run(ckpt, steps, fault_env)
+    faulted = err is not None
+    if faulted:
+        if fault_rc is None or f"rc={fault_rc}" not in err:
+            return {"cell": name, "mode": "cluster", "ok": False,
+                    "error": err[-400:]}
+        detail["fault_rc"] = fault_rc
+        # leg 2: restart with no fault -> must resume and complete
+        outs, err = _cluster_run(ckpt, steps)
+        if err is not None:
+            return {"cell": name, "mode": "cluster", "ok": False,
+                    "error": err[-400:]}
+        detail["resume_step"] = outs[0]["start_step"]
+    # the (possibly stitched) curve must equal the fault-free one
+    # bit-for-bit on every step it covers — and cover every step unless
+    # the fault leg legitimately truncated the front
+    stitched = _losses_by_step(outs[0])
+    tail = {s: v for s, v in clean_curve.items() if s in stitched}
+    ok = (stitched == tail
+          and (faulted or sorted(stitched) == sorted(clean_curve)))
+    return {"cell": name, "mode": "cluster", "ok": bool(ok), **detail}
+
+
+def run_cluster_grid(tmp, steps):
+    clean_dir = os.path.join(tmp, "clean")
+    outs, err = _cluster_run(clean_dir, steps)
+    if err is not None:
+        if "Multiprocess computations aren't implemented" in err:
+            print(json.dumps({"cell": "cluster_grid", "mode": "cluster",
+                              "ok": None,
+                              "skipped": "no multi-process CPU support"}))
+            return []
+        print(json.dumps({"cell": "clean", "mode": "cluster", "ok": False,
+                          "error": err[-400:]}))
+        return [False]
+    clean_curve = _losses_by_step(outs[0])
+
+    mid, late = steps // 2, steps - 1
+    from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
+    grid = [
+        # hard kill of one proc mid-run (the pre-existing fault knob)
+        (f"kill:p1@{mid}", {"PTPU_FAULT_PROC": "1",
+                            "PTPU_FAULT_STEP": str(mid)}, 17),
+        # fleet-wide SIGTERM preemption -> emergency ckpt + exit 75
+        (f"sigterm@{mid}", {"PTPU_CHAOS_SIGTERM_STEP": str(mid)},
+         PREEMPT_EXIT_CODE),
+        # newest checkpoint torn after commit (both corruption modes)
+        (f"corrupt:truncate@{late}",
+         {"PTPU_CHAOS_CORRUPT_STEP": str(late),
+          "PTPU_CHAOS_CORRUPT_MODE": "truncate"}, None),
+        (f"corrupt:manifest@{late}",
+         {"PTPU_CHAOS_CORRUPT_STEP": str(late),
+          "PTPU_CHAOS_CORRUPT_MODE": "manifest"}, None),
+        # 2-step NaN burst absorbed by the bad-step guard
+        (f"nan@{mid}:{mid + 1}",
+         {"PTPU_CHAOS_NAN_STEP": f"{mid}:{mid + 1}",
+          "PTPU_BAD_STEP_BUDGET": "3"}, None),
+        # transient rendezvous + shard-write failures absorbed by retry
+        ("init_flap+ckpt_io",
+         {"PTPU_CHAOS_INIT_FAIL": "1", "PTPU_CHAOS_CKPT_IO": "2"}, None),
+    ]
+    oks = []
+    for name, env, rc in grid:
+        verdict = _cluster_cell(name, tmp, steps, env, rc, clean_curve)
+        print(json.dumps(verdict))
+        oks.append(verdict["ok"])
+    return oks
+
+
+# -- in-process cells (always runnable) -------------------------------------
+
+def _inproc_run(ckpt, steps, budget=None):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.io.checkpoint import CheckpointManager
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, make_mesh)
+    from paddle_tpu.resilience.supervisor import train_resilient
+
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    trainer = MeshTrainer(
+        MLP(hidden=(8,), num_classes=4), Adam(1e-2),
+        supervised_loss(lambda lg, y: F.softmax_with_cross_entropy(lg, y)),
+        mesh, strategy=DistStrategy(bad_step_budget=budget))
+    ts = trainer.init_state(jnp.zeros((16, 6)))
+    mgr = CheckpointManager(ckpt, max_to_keep=steps + 1)
+    restored, start = mgr.restore_latest(ts)
+    if restored is not None:
+        ts = restored
+    else:
+        start = 0
+
+    def batch_for(step):
+        rs = np.random.RandomState(1000 + step)
+        return (jnp.asarray(rs.randn(16, 6).astype(np.float32)),
+                jnp.asarray(rs.randint(0, 4, 16).astype(np.int64)))
+
+    losses = {}
+    train_resilient(trainer, ts, batch_for, steps, mgr, start_step=start,
+                    on_step=lambda s, f: losses.__setitem__(
+                        s, float(f["loss"])))
+    return losses
+
+
+def run_inprocess_grid(tmp, steps):
+    from paddle_tpu.resilience import chaos
+
+    clean = _inproc_run(os.path.join(tmp, "ip-clean"), steps)
+    mid, late = steps // 2, steps - 1
+    grid = [
+        (f"ip:nan@{mid}:{mid + 1}",
+         {"PTPU_CHAOS_NAN_STEP": f"{mid}:{mid + 1}"}, 3),
+        (f"ip:nan_budget_blown@{mid}",
+         {"PTPU_CHAOS_NAN_STEP": str(mid),
+          "PTPU_CHAOS_NAN_ATTEMPTS": "3"}, 2),
+        (f"ip:corrupt:truncate@{late}",
+         {"PTPU_CHAOS_CORRUPT_STEP": str(late),
+          "PTPU_CHAOS_CORRUPT_MODE": "truncate"}, None),
+        ("ip:ckpt_io", {"PTPU_CHAOS_CKPT_IO": "2"}, None),
+    ]
+    oks = []
+    for name, env, budget in grid:
+        os.environ.update(env)
+        chaos.reload()
+        try:
+            losses = _inproc_run(
+                os.path.join(tmp, name.replace(":", "-").replace("@", "-")),
+                steps, budget=budget)
+            ok = losses == clean
+            verdict = {"cell": name, "mode": "inprocess", "ok": bool(ok)}
+        except Exception as e:  # a cell must never take the sweep down
+            verdict = {"cell": name, "mode": "inprocess", "ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+        finally:
+            for k in env:
+                os.environ.pop(k, None)
+            chaos.reset()
+        print(json.dumps(verdict))
+        oks.append(verdict["ok"])
+    return oks
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--inprocess-only", action="store_true")
+    ap.add_argument("--tmp", default=None, help="scratch dir (default mkdtemp)")
+    args = ap.parse_args()
+
+    import tempfile
+    tmp = args.tmp or tempfile.mkdtemp(prefix="chaos_sweep_")
+    os.environ.setdefault("PTPU_RETRY_SCALE", "0.01")
+
+    oks = []
+    if not args.inprocess_only:
+        oks += run_cluster_grid(tmp, args.steps)
+    oks += run_inprocess_grid(tmp, args.steps)
+    ok = all(o for o in oks if o is not None)
+    print(json.dumps({"cell": "TOTAL", "ok": bool(ok),
+                      "cells": len(oks), "failed": sum(o is False for o in oks)}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
