@@ -28,12 +28,12 @@ import numpy as np
 
 from paddle_tpu.core.executor import Trainer, supervised_loss
 from paddle_tpu.data import datasets
-from paddle_tpu.io.inference import InferencePredictor, save_inference_model
 from paddle_tpu.metrics import accuracy
 from paddle_tpu.models import LeNet
 from paddle_tpu.ops import functional as F
 from paddle_tpu.optim.optimizer import Adam
 from paddle_tpu.quant.int8_compute import freeze_int8
+from paddle_tpu.testing import export_servable
 
 
 def batches(reader, bs):
@@ -83,19 +83,13 @@ def main():
     print(f"accuracy: float {a_f32:.3f}  int8 {a_int8:.3f} "
           f"(delta {a_f32 - a_int8:+.3f})")
 
-    # export the QUANTIZED model for serving
+    # export the QUANTIZED model for serving; export_servable(verify=True)
+    # round-trips the batch through InferencePredictor and asserts the
+    # served logits match direct apply
     d = tempfile.mkdtemp(prefix="int8_serve_")
-    path = os.path.join(d, "model")
-    save_inference_model(path, qmodel, qvars,
-                         [jnp.zeros((32, 28, 28, 1))], input_names=["x"])
-    pred = InferencePredictor(path)
-    out = pred.run({"x": held[0][0]})
-    first = out[0] if isinstance(out, (list, tuple)) else \
-        next(iter(out.values()))
-    served = np.asarray(first).argmax(-1)
-    direct = np.asarray(qmodel.apply(qvars, jnp.asarray(held[0][0]),
-                                     training=False)).argmax(-1)
-    assert (served == direct).all(), "served logits != direct apply"
+    path = export_servable(os.path.join(d, "model"), qmodel, qvars,
+                           [jnp.asarray(held[0][0])], input_names=["x"],
+                           verify=True)
     print(f"exported + served from {path}: predictions match direct apply")
 
 

@@ -48,6 +48,13 @@ def main():
     ap.add_argument("--dim", type=int, default=128)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="enable the resilient loop: checkpoint here, "
+                         "resume from the newest intact checkpoint, "
+                         "preemption-safe (SIGTERM => emergency save + "
+                         "reschedulable exit)")
+    ap.add_argument("--save-every", type=int, default=20,
+                    help="checkpoint cadence in steps (with --ckpt-dir)")
     args = ap.parse_args()
 
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -73,10 +80,33 @@ def main():
     batch = (jnp.asarray(tok[:, :-1]), jnp.asarray(tok[:, 1:]))
     print(f"device={jax.devices()[0].device_kind} seq={args.seq} "
           f"params={sum(x.size for x in jax.tree.leaves(ts.params)):,}")
-    for step in range(args.steps):
-        ts, out = trainer.train_step(ts, batch, rng=jax.random.key(step))
-        if step % 10 == 0 or step == args.steps - 1:
-            print(f"step {step:4d}  loss {float(out['loss']):.4f}")
+    if args.ckpt_dir:
+        # Resilient loop (resilience/supervisor.py): deterministic
+        # batch_for + resume-from-latest means a preempted run relaunched
+        # with the same command continues the same loss curve.
+        from paddle_tpu.io.checkpoint import CheckpointManager
+        from paddle_tpu.resilience.supervisor import train_resilient
+
+        manager = CheckpointManager(args.ckpt_dir, max_to_keep=3)
+        restored, rstep = manager.restore_latest(ts)
+        start = 0
+        if restored is not None:
+            ts, start = restored, rstep
+            print(f"resumed from {args.ckpt_dir} at step {start}")
+
+        def on_step(step, out):
+            if step % 10 == 0 or step == args.steps - 1:
+                print(f"step {step:4d}  loss {float(out['loss']):.4f}")
+
+        ts = train_resilient(trainer, ts, lambda step: batch, args.steps,
+                             manager, start_step=start,
+                             save_every=args.save_every,
+                             rng_for_step=jax.random.key, on_step=on_step)
+    else:
+        for step in range(args.steps):
+            ts, out = trainer.train_step(ts, batch, rng=jax.random.key(step))
+            if step % 10 == 0 or step == args.steps - 1:
+                print(f"step {step:4d}  loss {float(out['loss']):.4f}")
 
     # KV-cache generation: the (t+3)%V stream is learnable, so the
     # continuation should keep stepping by 3

@@ -47,6 +47,12 @@ def main(argv=None) -> int:
                    help="weak-scaling sweep over dp mesh sizes, e.g. "
                         "'1,2,4,8': per-chip throughput + efficiency "
                         "(per-chip batch from --batch_size, default 32)")
+    p.add_argument("--resume_file", default=None, metavar="PATH",
+                   help="preemption-safe sweeps: append each finished "
+                        "model's name here and skip names already present "
+                        "on relaunch; SIGTERM between models exits with "
+                        "the reschedulable preemption code "
+                        "(resilience/supervisor.py)")
     args = p.parse_args(argv)
 
     from paddle_tpu.benchmark.models import MODELS, run_model
@@ -96,8 +102,28 @@ def main(argv=None) -> int:
              else [m.strip() for m in args.model.split(",")])
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
 
+    # Preemption-safe sweep: a long `--model all` run on a preemptible
+    # slice records progress per model and checks for a delivered
+    # SIGTERM/SIGINT at each model boundary (mid-model state is
+    # worthless — a timing window is only meaningful complete).
+    supervisor = None
+    done: set = set()
+    if args.resume_file:
+        import os
+
+        from paddle_tpu.resilience.supervisor import RunSupervisor
+        if os.path.exists(args.resume_file):
+            with open(args.resume_file) as f:
+                done = {line.strip() for line in f if line.strip()}
+        supervisor = RunSupervisor().install()
+
     results = []
     for name in names:
+        if name in done:
+            print(f"{name:>14}  (done in {args.resume_file}; skipped)")
+            continue
+        if supervisor is not None:
+            supervisor.maybe_preempt_exit(None, len(results))
         if args.infer:
             from paddle_tpu.benchmark.models import INFER_MODELS, run_infer
             if name not in INFER_MODELS:
@@ -121,6 +147,11 @@ def main(argv=None) -> int:
             print(f"{name:>14}  {r.value:12.1f} {r.unit:<9} "
                   f"{r.ms_per_step:8.2f} ms/step  {tf} TF/s  MFU {mfu}  "
                   f"vs_ref {vs}  [{r.device}]")
+        if args.resume_file:
+            with open(args.resume_file, "a") as f:
+                f.write(name + "\n")
+    if supervisor is not None:
+        supervisor.uninstall()
     return 0
 
 

@@ -1,0 +1,337 @@
+"""ServeEngine: the online inference serve loop.
+
+Ties the subsystem together (ENGINE.md): a `PagedKVCache` holds KV
+state in block pools, a `Scheduler` plans one prefill or decode batch
+per step, and this engine compiles + executes the steps, samples
+tokens host-side, streams them to per-request callbacks, and emits
+structured `serve_event` JSON (utils/log.py) for observability.
+
+Shape discipline — the one-compilation rule: continuous batching
+mutates batch membership every step, which naively means a fresh XLA
+compile every step. Instead every device call runs at a FIXED shape:
+
+- decode is always [max_batch_size] rows; empty rows are padding that
+  reads/writes the reserved scratch block 0 (context_len 1, slot 0) so
+  they can never touch a live sequence. One compile, ever.
+- prefill is always [max_batch_size, T] with T bucketed to the next
+  power of two — one compile per bucket, O(log max_seq_len) total.
+
+Padding rows cost FLOPs but rows of a batch are computed independently
+by every op in the model, so a request's logits are bit-identical
+whether it shares the batch or runs alone — this is what makes
+continuous batching safe to verify token-for-token against sequential
+decode (tests/test_engine.py), not just "close".
+
+Sampling runs on host from the [B, V] logits (greedy / temperature /
+top-k). Stochastic sampling derives its rng stream from
+(request seed, absolute position), never from batch composition, so
+scheduling decisions can't change a request's output.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.module import Context, _CtxCore
+from paddle_tpu.engine.paged_cache import PagedKVCache
+from paddle_tpu.engine.scheduler import Request, Scheduler
+from paddle_tpu.utils.log import serve_event
+
+
+def _fresh_cx(variables) -> Context:
+    return Context(_CtxCore(mode="apply", variables=variables, mutated={},
+                            rng=None, rng_count=0, training=False))
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def serve_metadata(model) -> dict:
+    """Introspect a CausalLM into the manifest `serve` block
+    (io/inference.py `save_inference_model(..., serve_meta=...)`):
+    everything `ServeEngine.from_saved_model` needs to rebuild the
+    module and size its KV pools without touching the checkpoint."""
+    attn = model.blocks[0].attn
+    return {
+        "model_type": "causal_lm",
+        "vocab": model.vocab,
+        "model_dim": model.model_dim,
+        "num_heads": attn.num_heads,
+        "num_kv_heads": attn.num_kv_heads,
+        "head_dim": attn.head_dim,
+        "num_layers": len(model.blocks),
+        "ffn_dim": model.blocks[0].ffn.fc1.features,
+        "max_len": model.max_len,
+        "tie_embeddings": model.tie_embeddings,
+        "fused_qkv": attn.fused_qkv,
+    }
+
+
+def _sample(logits: np.ndarray, req: Request, pos: int) -> int:
+    """Host-side sampling for one row. Deterministic in (req.seed, pos):
+    the same request samples the same token at the same position no
+    matter what batch it rode in."""
+    if req.temperature <= 0.0:
+        return int(np.argmax(logits))
+    z = logits.astype(np.float64) / req.temperature
+    if 0 < req.top_k < z.size:
+        kth = np.partition(z, -req.top_k)[-req.top_k]
+        z = np.where(z < kth, -np.inf, z)
+    z = z - z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    rng = np.random.default_rng([req.seed & 0x7FFFFFFF, pos])
+    return int(rng.choice(z.size, p=p))
+
+
+class ServeEngine:
+    """Continuous-batching serve loop over a CausalLM.
+
+    add_request() enqueues; step() advances the world by one scheduler
+    plan (one prefill or decode batch); run() drains the queue. Token
+    callbacks fire as tokens are sampled — streaming falls out of
+    iteration-level scheduling for free.
+    """
+
+    def __init__(self, model, variables, max_batch_size: int = 4,
+                 block_size: int = 16, num_blocks: int = 256,
+                 max_seq_len: Optional[int] = None,
+                 max_prefill_tokens: int = 512,
+                 min_prefill_bucket: int = 16):
+        self.model = model
+        self.variables = variables
+        attn = model.blocks[0].attn
+        self.max_seq_len = min(max_seq_len or model.max_len, model.max_len)
+        self.max_batch_size = max_batch_size
+        self.min_prefill_bucket = min_prefill_bucket
+        self.cache = PagedKVCache(
+            num_layers=len(model.blocks), num_blocks=num_blocks,
+            block_size=block_size, num_kv_heads=attn.num_kv_heads,
+            head_dim=attn.head_dim, dtype=model.dtype)
+        self.max_blocks_per_seq = self.cache.blocks_for(self.max_seq_len)
+        self.scheduler = Scheduler(
+            self.cache, max_batch_size=max_batch_size,
+            max_prefill_tokens=max_prefill_tokens,
+            max_seq_len=self.max_seq_len - 1)  # leave room for >=1 new token
+        self.scheduler.on_preempt = self._on_preempt
+        self.finished: Dict[int, Request] = {}
+        self.steps = 0
+
+        model_ = model
+
+        @jax.jit
+        def _prefill(variables, tokens, last_pos):
+            logits, kvs = model_.prefill_paged(_fresh_cx(variables), tokens,
+                                               last_pos)
+            return logits, kvs
+
+        @jax.jit
+        def _scatter(pools, kvs, slots):
+            new_pools = []
+            for (kp, vp), (k, v) in zip(pools, kvs):
+                flat = (kp.shape[0] * kp.shape[1],) + kp.shape[2:]
+                kf = k.reshape((-1,) + k.shape[2:]).astype(kp.dtype)
+                vf = v.reshape((-1,) + v.shape[2:]).astype(vp.dtype)
+                new_pools.append((
+                    kp.reshape(flat).at[slots].set(kf).reshape(kp.shape),
+                    vp.reshape(flat).at[slots].set(vf).reshape(vp.shape)))
+            return new_pools
+
+        @jax.jit
+        def _decode(variables, tokens, positions, pools, block_tables,
+                    context_lens, slots):
+            return model_.decode_step_paged(
+                _fresh_cx(variables), tokens, positions, pools,
+                block_tables, context_lens, slots)
+
+        self._prefill = _prefill
+        self._scatter = _scatter
+        self._decode = _decode
+
+    # -- construction from an exported artifact ---------------------------
+    @classmethod
+    def from_saved_model(cls, model_dir: str, **engine_kwargs):
+        """Build model + engine from a save_inference_model() directory
+        whose manifest carries the `serve` block (serve_metadata)."""
+        import json
+        import os
+
+        from paddle_tpu.io.checkpoint import load_checkpoint
+        from paddle_tpu.models.transformer import CausalLM
+
+        with open(os.path.join(model_dir, "signature.json")) as f:
+            sig = json.load(f)
+        meta = sig.get("serve")
+        if meta is None:
+            raise ValueError(
+                f"{model_dir} has no `serve` metadata in its manifest; "
+                "re-export with save_inference_model(..., "
+                "serve_meta=serve_metadata(model))")
+        model = CausalLM(
+            vocab=meta["vocab"], model_dim=meta["model_dim"],
+            num_heads=meta["num_heads"], num_layers=meta["num_layers"],
+            ffn_dim=meta["ffn_dim"], dropout=0.0, max_len=meta["max_len"],
+            tie_embeddings=meta["tie_embeddings"],
+            fused_qkv=meta["fused_qkv"],
+            num_kv_heads=meta["num_kv_heads"])
+        variables = load_checkpoint(os.path.join(model_dir, "params"))
+        engine_kwargs.setdefault("max_seq_len", meta["max_len"])
+        return cls(model, variables, **engine_kwargs)
+
+    # -- intake -----------------------------------------------------------
+    def add_request(self, prompt: List[int], max_new_tokens: int = 32,
+                    temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                    eos_id: Optional[int] = None,
+                    callback: Optional[Callable[[int], None]] = None
+                    ) -> Request:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + 1 > self.max_seq_len:
+            raise ValueError(f"prompt len {len(prompt)} leaves no room to "
+                             f"generate under max_seq_len {self.max_seq_len}")
+        if len(prompt) > self.scheduler.max_prefill_tokens:
+            raise ValueError(
+                f"prompt len {len(prompt)} exceeds max_prefill_tokens "
+                f"{self.scheduler.max_prefill_tokens}; it could never admit")
+        req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
+                      temperature=temperature, top_k=top_k, seed=seed,
+                      eos_id=eos_id, callback=callback)
+        req.enqueue_time = time.monotonic()
+        self.scheduler.add(req)
+        serve_event("serve_admit", req_id=req.req_id,
+                    prompt_len=len(prompt),
+                    queue_depth=self.scheduler.queue_depth)
+        return req
+
+    # -- serve loop --------------------------------------------------------
+    def step(self) -> bool:
+        """Advance one scheduler plan. Returns False when idle."""
+        plan = self.scheduler.next_batch()
+        if plan is None:
+            return False
+        kind, reqs = plan
+        self.steps += 1
+        if kind == "prefill":
+            self._step_prefill(reqs)
+        else:
+            self._step_decode(reqs)
+        return True
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drain the queue; returns {req_id: generated token ids}."""
+        while self.step():
+            pass
+        return {rid: self._generated_of(r)
+                for rid, r in self.finished.items()}
+
+    # -- internals ---------------------------------------------------------
+    def _step_prefill(self, reqs: List[Request]) -> None:
+        n = self.max_batch_size
+        t_real = max(len(r.tokens) for r in reqs)
+        t_pad = max(_next_pow2(t_real), self.min_prefill_bucket)
+        t_pad = min(t_pad, self.model.max_len)   # bucket cap: pe table length
+        tokens = np.zeros((n, t_pad), np.int32)
+        last_pos = np.zeros((n,), np.int32)
+        # padded rows / positions scatter into scratch block 0 (slot < bs)
+        slots = np.zeros((n * t_pad,), np.int32)
+        for i, r in enumerate(reqs):
+            toks = r.tokens
+            tokens[i, :len(toks)] = toks
+            last_pos[i] = len(toks) - 1
+            for p in range(len(toks)):
+                slots[i * t_pad + p] = self.cache.slot_of(r.req_id, p)
+        logits, kvs = self._prefill(self.variables, jnp.asarray(tokens),
+                                    jnp.asarray(last_pos))
+        self.cache.pools = self._scatter(self.cache.pools, kvs,
+                                         jnp.asarray(slots))
+        logits = np.asarray(logits)
+        now = time.monotonic()
+        for i, r in enumerate(reqs):
+            tok = _sample(logits[i], r, len(r.tokens))
+            if not r.first_token_time:
+                r.first_token_time = now
+            self._emit_token(r, tok)
+        serve_event("serve_prefill", batch=len(reqs), padded_t=t_pad,
+                    step=self.steps, occupancy=round(self.cache.occupancy(), 4),
+                    queue_depth=self.scheduler.queue_depth)
+
+    def _step_decode(self, reqs: List[Request]) -> None:
+        b = self.max_batch_size
+        mb = self.max_blocks_per_seq
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        context_lens = np.ones((b,), np.int32)   # pad rows: 1 token of scratch
+        block_tables = np.zeros((b, mb), np.int32)
+        slots = np.zeros((b,), np.int32)
+        for i, r in enumerate(reqs):
+            pos = self.cache.seq_len(r.req_id)   # next-token position
+            tokens[i] = r.generated[-1]
+            positions[i] = pos
+            context_lens[i] = pos + 1
+            block_tables[i] = self.cache.padded_table(r.req_id, mb)
+            slots[i] = self.cache.slot_of(r.req_id, pos)
+        logits, self.cache.pools = self._decode(
+            self.variables, jnp.asarray(tokens), jnp.asarray(positions),
+            self.cache.pools, jnp.asarray(block_tables),
+            jnp.asarray(context_lens), jnp.asarray(slots))
+        logits = np.asarray(logits)
+        for i, r in enumerate(reqs):
+            self.cache.advance(r.req_id)
+            tok = _sample(logits[i], r, self.cache.seq_len(r.req_id))
+            self._emit_token(r, tok)
+        serve_event("serve_decode", batch=len(reqs), step=self.steps,
+                    occupancy=round(self.cache.occupancy(), 4),
+                    queue_depth=self.scheduler.queue_depth)
+
+    def _emit_token(self, req: Request, tok: int) -> None:
+        req.generated.append(tok)
+        if req.callback is not None:
+            req.callback(tok)
+        hit_eos = req.eos_id is not None and tok == req.eos_id
+        out_of_room = (len(req.tokens) >= self.max_seq_len - 1)
+        if hit_eos or req.num_generated >= req.max_new_tokens or out_of_room:
+            self._finish(req, "eos" if hit_eos else "length")
+
+    def _finish(self, req: Request, reason: str) -> None:
+        req.finish_time = time.monotonic()
+        self.scheduler.finish(req, reason)
+        self.finished[req.req_id] = req
+        ttft_ms = (req.first_token_time - req.enqueue_time) * 1e3
+        decode_s = max(req.finish_time - req.first_token_time, 1e-9)
+        n_gen = req.num_generated
+        serve_event("serve_done", req_id=req.req_id, reason=reason,
+                    tokens=n_gen, ttft_ms=round(ttft_ms, 3),
+                    decode_tok_s=round(max(n_gen - 1, 0) / decode_s, 2),
+                    preemptions=req.preemptions)
+
+    def _on_preempt(self, req: Request) -> None:
+        serve_event("serve_preempt", req_id=req.req_id,
+                    kept_tokens=len(req.prompt),
+                    occupancy=round(self.cache.occupancy(), 4))
+
+    # -- convenience --------------------------------------------------------
+    def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,
+                 **kwargs) -> List[List[int]]:
+        """Batch-submit prompts, drain, return generations in order."""
+        reqs = [self.add_request(p, max_new_tokens=max_new_tokens, **kwargs)
+                for p in prompts]
+        self.run()
+        return [self._generated_of(r) for r in reqs]
+
+    @staticmethod
+    def _generated_of(req: Request) -> List[int]:
+        """All tokens generated for a request, reassembling the ones a
+        preemption folded into the prompt."""
+        if req.preempt_carry:
+            carried = req.prompt[len(req.prompt) - req.preempt_carry:]
+            return list(carried) + list(req.generated)
+        return list(req.generated)

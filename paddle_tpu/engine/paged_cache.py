@@ -1,0 +1,143 @@
+"""PagedKVCache: block-pool KV storage for online inference.
+
+The HBM side of continuous batching (ENGINE.md): instead of one dense
+[B, Tmax, Hkv, hd] cache per batch slot — which reserves worst-case
+HBM for every request and welds batch membership to allocation — KV
+state lives in ONE pool of fixed-size token blocks per layer
+([num_blocks, block_size, Hkv, hd] for k and for v). A sequence owns a
+BLOCK TABLE (ordered list of pool block ids); growing a sequence
+appends a block from the free list, finishing/evicting one returns its
+blocks in O(blocks). Fragmentation is bounded at block_size-1 wasted
+slots per sequence, and admission capacity is a pure free-list check.
+
+Host/device split: this class is the HOST-side allocator + bookkeeping
+(free list, per-sequence tables, lengths). The device-side pools are
+jnp arrays held in `self.pools` and are updated FUNCTIONALLY — the
+jitted prefill-scatter / decode step return new pool arrays and the
+engine assigns them back. Nothing here traces into XLA; block tables
+cross into jit as plain int32 operands.
+
+Block 0 is reserved as a scratch block: padded batch rows (the engine
+pads decode batches to a fixed size for one-compilation serving) write
+their garbage k/v there, so a dummy row can never corrupt a live
+sequence.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+
+
+class CacheExhausted(Exception):
+    """No free blocks; the scheduler must evict (preempt) a sequence."""
+
+
+class PagedKVCache:
+    """Block-pool KV cache shared by all layers of one model.
+
+    All layers allocate in lockstep (a token occupies the same slot in
+    every layer's pool), so ONE free list / block table set serves the
+    whole stack; `pools` holds per-layer (k_pool, v_pool) arrays.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is scratch)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        shape = (num_blocks, block_size, num_kv_heads, head_dim)
+        self.pools: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+            (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+            for _ in range(num_layers)]
+        # block 0 reserved for padded/dummy rows — never handed out
+        self._free = deque(range(1, num_blocks))
+        self._tables: Dict[int, List[int]] = {}
+        self._lens: Dict[int, int] = {}
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable blocks in use (serve_event metric)."""
+        return self.used_blocks / max(1, self.num_blocks - 1)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.blocks_for(num_tokens) <= len(self._free)
+
+    # -- sequence lifecycle ----------------------------------------------
+    def alloc_sequence(self, seq_id: int, num_tokens: int) -> None:
+        """Reserve blocks for a sequence's first num_tokens (prefill).
+        Raises CacheExhausted (allocating nothing) when the free list is
+        short — the scheduler turns that into deferred admission or
+        preemption."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        need = self.blocks_for(num_tokens)
+        if need > len(self._free):
+            raise CacheExhausted(
+                f"need {need} blocks, {len(self._free)} free")
+        self._tables[seq_id] = [self._free.popleft() for _ in range(need)]
+        self._lens[seq_id] = num_tokens
+
+    def append_token(self, seq_id: int) -> int:
+        """Reserve the slot for this sequence's next token (allocating a
+        fresh block at a block boundary); returns the FLAT pool slot
+        (block_id * block_size + offset) the engine passes to the decode
+        step. Does NOT advance the length — call advance() after the
+        step actually writes."""
+        pos = self._lens[seq_id]
+        table = self._tables[seq_id]
+        if pos == len(table) * self.block_size:     # block boundary
+            if not self._free:
+                raise CacheExhausted("no free block for decode append")
+            table.append(self._free.popleft())
+        return table[pos // self.block_size] * self.block_size \
+            + pos % self.block_size
+
+    def advance(self, seq_id: int) -> None:
+        self._lens[seq_id] += 1
+
+    def free_sequence(self, seq_id: int) -> int:
+        """Return a finished/evicted sequence's blocks; returns how many."""
+        blocks = self._tables.pop(seq_id, [])
+        self._lens.pop(seq_id, None)
+        self._free.extend(blocks)
+        return len(blocks)
+
+    # -- views for the jitted step ---------------------------------------
+    def seq_len(self, seq_id: int) -> int:
+        return self._lens[seq_id]
+
+    def block_table(self, seq_id: int) -> List[int]:
+        return list(self._tables[seq_id])
+
+    def slot_of(self, seq_id: int, pos: int) -> int:
+        """Flat pool slot of an ALREADY-RESERVED position."""
+        table = self._tables[seq_id]
+        return table[pos // self.block_size] * self.block_size \
+            + pos % self.block_size
+
+    def padded_table(self, seq_id: int, max_blocks: int) -> List[int]:
+        """Block table right-padded with scratch block 0 to the fixed
+        width the compiled decode step expects."""
+        table = self._tables[seq_id]
+        if len(table) > max_blocks:
+            raise ValueError(f"sequence {seq_id} spans {len(table)} blocks "
+                             f"> max {max_blocks}")
+        return table + [0] * (max_blocks - len(table))

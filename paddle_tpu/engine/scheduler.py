@@ -1,0 +1,204 @@
+"""Continuous batching scheduler (iteration-level scheduling, Orca-style).
+
+Classic static batching admits a batch, decodes until EVERY member
+finishes, then admits the next — short requests wait on the longest
+one, and freed KV memory idles. Continuous batching reschedules every
+STEP: finished sequences leave the running set immediately, waiting
+requests are admitted the moment blocks free up, and each step the
+scheduler hands the engine either one prefill batch or one decode
+batch over the current running set.
+
+Policy (simple and deterministic, ENGINE.md §scheduler):
+
+- Prefill-priority: if any waiting request fits (KV blocks available,
+  a running slot open, prompt under the per-step token budget), run a
+  prefill step admitting as many as fit, FIFO. New requests reach
+  their first token fast (TTFT), at the cost of slightly delaying
+  in-flight decodes for one step.
+- Otherwise run one decode step over all running sequences (one token
+  each).
+- Preemption by recompute: when decode needs a block and the pool is
+  empty, the LAST-admitted running request is evicted — its blocks are
+  freed and it rejoins the FRONT of the waiting queue with
+  prompt := prompt + generated, so its re-prefill reproduces the exact
+  KV state (cheaper than copy-out for short sequences, and the
+  deterministic choice keeps tests reproducible). FIFO order of the
+  others is preserved.
+
+The scheduler owns no device state; it manipulates the PagedKVCache's
+host-side bookkeeping and Request objects. The engine turns its plans
+into jitted prefill/decode calls.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
+
+# request lifecycle: WAITING -> RUNNING -> FINISHED (PREEMPTED -> WAITING)
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+_req_ids = itertools.count()
+
+
+@dataclass
+class Request:
+    """One inference request; `prompt` grows on preemption (recompute)."""
+    prompt: List[int]
+    max_new_tokens: int = 32
+    temperature: float = 0.0          # 0 => greedy
+    top_k: int = 0                    # 0 => full vocab
+    seed: int = 0
+    eos_id: Optional[int] = None
+    callback: Optional[Callable[[int], None]] = None  # per-token stream
+    req_id: int = field(default_factory=lambda: next(_req_ids))
+    generated: List[int] = field(default_factory=list)
+    state: str = WAITING
+    preemptions: int = 0
+    preempt_carry: int = 0            # tokens folded into prompt on preempt
+    enqueue_time: float = 0.0
+    first_token_time: float = 0.0
+    finish_time: float = 0.0
+    finish_reason: str = ""
+
+    @property
+    def tokens(self) -> List[int]:
+        """Prompt as the cache must hold it (original + regenerated)."""
+        return self.prompt + self.generated
+
+    @property
+    def num_generated(self) -> int:
+        """Tokens generated across preemptions (prompt absorbs them)."""
+        return len(self.generated) + self.preempt_carry
+
+
+class Scheduler:
+    """Decides, per engine step, what work runs: a prefill batch or a
+    decode batch. Bounds: `max_batch_size` concurrent running
+    sequences (the engine compiles its decode step for exactly this
+    batch), `max_prefill_tokens` padded prompt tokens per prefill step,
+    `max_seq_len` ceiling on prompt+generation."""
+
+    def __init__(self, cache: PagedKVCache, max_batch_size: int = 8,
+                 max_prefill_tokens: int = 512, max_seq_len: int = 2048):
+        self.cache = cache
+        self.max_batch_size = max_batch_size
+        self.max_prefill_tokens = max_prefill_tokens
+        self.max_seq_len = max_seq_len
+        self.waiting: deque[Request] = deque()
+        self.running: List[Request] = []
+        # engine hook, fired after a preemption moves a req back to waiting
+        self.on_preempt: Optional[Callable[[Request], None]] = None
+
+    # -- intake -----------------------------------------------------------
+    def add(self, req: Request) -> None:
+        if len(req.prompt) > self.max_seq_len:
+            raise ValueError(
+                f"prompt len {len(req.prompt)} > max_seq_len {self.max_seq_len}")
+        req.state = WAITING
+        self.waiting.append(req)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- planning ---------------------------------------------------------
+    def next_batch(self) -> Optional[Tuple[str, List[Request]]]:
+        """Plan one step: ("prefill", admitted) | ("decode", running) |
+        None when idle. Prefill admission allocates cache blocks and
+        moves requests to RUNNING; decode planning guarantees every
+        running sequence has its next-token block reserved, preempting
+        if the pool runs dry."""
+        admitted = self._try_admit()
+        if admitted:
+            return ("prefill", admitted)
+        if self.running:
+            self._reserve_decode_blocks()
+            if self.running:
+                return ("decode", list(self.running))
+            # everything got preempted; retry admission with freed blocks
+            admitted = self._try_admit()
+            if admitted:
+                return ("prefill", admitted)
+        if self.waiting and not self.running:
+            # liveness check: with an idle engine and an empty pool, a
+            # head request that still can't admit NEVER will — fail loud
+            # instead of silently stranding it in the queue
+            req = self.waiting[0]
+            n = len(req.tokens)
+            if (n > self.max_prefill_tokens
+                    or self.cache.blocks_for(n) > self.cache.num_blocks - 1):
+                raise CacheExhausted(
+                    f"request {req.req_id} ({n} tokens incl. "
+                    f"{req.preempt_carry} preempt-folded) can never be "
+                    f"scheduled; raise max_prefill_tokens "
+                    f"({self.max_prefill_tokens}) or num_blocks "
+                    f"({self.cache.num_blocks})")
+        return None
+
+    def _try_admit(self) -> List[Request]:
+        admitted: List[Request] = []
+        budget = self.max_prefill_tokens
+        while self.waiting:
+            req = self.waiting[0]
+            n = len(req.tokens)
+            if (len(self.running) + len(admitted) >= self.max_batch_size
+                    or n > budget
+                    or not self.cache.can_allocate(n)):
+                break       # FIFO: don't skip ahead of the head request
+            self.waiting.popleft()
+            self.cache.alloc_sequence(req.req_id, n)
+            req.state = RUNNING
+            admitted.append(req)
+            budget -= n
+        self.running.extend(admitted)
+        return admitted
+
+    def _reserve_decode_blocks(self) -> None:
+        """Ensure every running sequence can hold one more token,
+        evicting from the tail (last admitted) until allocation holds."""
+        i = 0
+        while i < len(self.running):
+            req = self.running[i]
+            try:
+                self.cache.append_token(req.req_id)
+                i += 1
+            except CacheExhausted:
+                if len(self.running) == 1:
+                    raise CacheExhausted(
+                        "single sequence exceeds total KV pool; "
+                        "increase num_blocks or lower max_seq_len")
+                victim = self.running[-1]
+                if victim is req:
+                    victim = self.running[-2]
+                self.preempt(victim)
+                # re-check same index (list may have shifted under us)
+                i = self.running.index(req) if req in self.running else i
+
+    def preempt(self, req: Request) -> None:
+        """Evict by recompute: free blocks, fold generated tokens into the
+        prompt, and requeue at the FRONT so it re-prefills first."""
+        self.cache.free_sequence(req.req_id)
+        self.running.remove(req)
+        req.preempt_carry += len(req.generated)
+        req.prompt = req.prompt + req.generated
+        req.generated = []
+        req.preemptions += 1
+        req.state = WAITING
+        self.waiting.appendleft(req)
+        if self.on_preempt is not None:
+            self.on_preempt(req)
+
+    # -- completion -------------------------------------------------------
+    def finish(self, req: Request, reason: str) -> None:
+        self.cache.free_sequence(req.req_id)
+        self.running.remove(req)
+        req.state = FINISHED
+        req.finish_reason = reason

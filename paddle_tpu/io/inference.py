@@ -25,6 +25,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+# jax 0.4.x: the `jax.export` ATTRIBUTE raises (accelerated deprecation
+# shim) while the submodule imports fine — bind the module directly
+from jax import export as jax_export
 
 from paddle_tpu.core.module import Module, Variables
 from paddle_tpu.io.checkpoint import load_checkpoint, save_checkpoint
@@ -47,13 +50,21 @@ def _prune_empty(tree):
 
 def save_inference_model(path: str, module_or_fn, variables: Variables,
                          example_inputs: Sequence[Any],
-                         input_names: Optional[Sequence[str]] = None) -> str:
+                         input_names: Optional[Sequence[str]] = None,
+                         serve_meta: Optional[Dict] = None) -> str:
     """Export a servable model directory.
 
     module_or_fn: a Module (its apply in eval mode is exported) or a pure
     fn(variables, *inputs). The exported computation closes over nothing —
     params are explicit inputs so the same artifact serves any checkpoint
     with the same structure.
+
+    serve_meta: optional dict recorded as the manifest's `serve` block
+    (engine.serve_metadata(model) for a CausalLM: max seq length, KV
+    head count/dim, vocab size, layer config) so
+    `ServeEngine.from_saved_model` can rebuild the module and size its
+    KV pools without re-deriving shapes from the checkpoint. Manifests
+    written without it stay loadable everywhere (readers use .get).
     """
     if isinstance(module_or_fn, Module):
         module = module_or_fn
@@ -70,7 +81,7 @@ def save_inference_model(path: str, module_or_fn, variables: Variables,
     # inference ProgramDesc being executor-agnostic, io.py:859).
     variables = jax.tree.map(np.asarray, variables)
     example_inputs = tuple(jnp.asarray(x) for x in example_inputs)
-    exported = jax.export.export(jax.jit(fn))(variables, *example_inputs)
+    exported = jax_export.export(jax.jit(fn))(variables, *example_inputs)
     blob = exported.serialize()
 
     os.makedirs(path, exist_ok=True)
@@ -84,6 +95,8 @@ def save_inference_model(path: str, module_or_fn, variables: Variables,
         "inputs": [{"shape": list(x.shape), "dtype": str(x.dtype)}
                    for x in example_inputs],
     }
+    if serve_meta is not None:
+        sig["serve"] = dict(serve_meta)
     with open(os.path.join(path, _SIG), "w") as f:
         json.dump(sig, f, indent=1)
     return path
@@ -92,7 +105,7 @@ def save_inference_model(path: str, module_or_fn, variables: Variables,
 def load_inference_model(path: str) -> Tuple[Callable, Variables, Dict]:
     """Returns (callable(variables, *inputs), variables, signature)."""
     with open(os.path.join(path, _HLO), "rb") as f:
-        exported = jax.export.deserialize(f.read())
+        exported = jax_export.deserialize(f.read())
     variables = load_checkpoint(os.path.join(path, _PARAMS))
     with open(os.path.join(path, _SIG)) as f:
         sig = json.load(f)

@@ -1,1 +1,1 @@
-from paddle_tpu.kernels import attention
+from paddle_tpu.kernels import attention, paged_attention

@@ -112,7 +112,9 @@ def _scratch(shape):
 def _compiler_params(*semantics):
     if pltpu is None:  # pragma: no cover
         return None
-    return pltpu.CompilerParams(dimension_semantics=semantics)
+    # jax <= 0.4.x spells it TPUCompilerParams; newer jax CompilerParams
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=semantics)
 
 
 def _smem_spec():

@@ -1,0 +1,201 @@
+"""Paged decode attention: K/V gathered through per-sequence block tables.
+
+The serving-side sibling of kernels/flash.py. Online inference
+(engine/) stores each sequence's KV history as a list of fixed-size
+token blocks inside one shared pool ([num_blocks, block_size, Hkv, Dh]
+per layer), so admission/eviction never copies KV state and a ragged
+batch wastes at most block_size-1 slots per sequence ("Ragged Paged
+Attention", arxiv 2604.15464). Decode attention then has to gather K/V
+through the block table instead of slicing a dense [B, Tmax] cache.
+
+Two implementations with one contract (mirroring attention.py's
+flash/reference split):
+
+- `paged_attention_reference` — pure-XLA gather + dense attention.
+  Runs anywhere, is the numerics oracle for tests, and is what the
+  dispatcher uses off-TPU.
+- a Pallas kernel — grid (B, blocks_per_seq); the block table rides
+  scalar prefetch (pltpu.PrefetchScalarGridSpec) so the *index map*
+  picks which pool block to DMA into VMEM: the gather IS the block
+  fetch, no [B, T, Hkv, Dh] contiguous K/V ever materializes. The kv
+  axis is sequential ("arbitrary") with online-softmax scratch, and
+  blocks past a sequence's context length are skipped entirely, so a
+  ragged batch costs ~sum(ceil(len_i/bs)) block reads, not B*max_len.
+  Runs in interpret mode on CPU so tests validate it without TPU
+  hardware (same policy as kernels/flash.py).
+
+Layout: q is [B, H, Dh] (one query token per sequence — decode);
+pools are [NB, BS, Hkv, Dh]; block_tables [B, MB] int32 pool-block
+ids; context_lens [B] int32 valid-token counts. GQA/MQA: Hkv may
+divide H; the grouped einsum reads each kv head once.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports without TPU hardware; interpret mode needs no TPU.
+    from jax.experimental.pallas import tpu as pltpu
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+from paddle_tpu.kernels.attention import reference_attention
+
+NEG_INF = -1e9
+LANES = 128   # online-softmax m/l scratch is lane-broadcast, as in flash.py
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens,
+                              scale: Optional[float] = None):
+    """Oracle path: gather blocks dense, mask past context_len, run
+    reference_attention. q: [B, H, D]; pools: [NB, BS, Hkv, D];
+    block_tables: [B, MB] int32; context_lens: [B] int32 -> [B, H, D]."""
+    b, h, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    k = k_pool[block_tables].reshape(b, mb * bs, hkv, d)
+    v = v_pool[block_tables].reshape(b, mb * bs, hkv, d)
+    mask = (jnp.arange(mb * bs)[None, :]
+            < context_lens[:, None])[:, None, None, :]
+    return reference_attention(q[:, None].astype(k.dtype), k, v, mask=mask,
+                               scale=scale)[:, 0].astype(q.dtype)
+
+
+def _scratch(shape):
+    if _VMEM is None:  # pragma: no cover
+        raise RuntimeError(
+            "Pallas TPU support unavailable in this jax build; use "
+            "paged_attention_reference (use_kernel=False)")
+    return _VMEM(shape, jnp.float32)
+
+
+def _paged_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, block_size: int,
+                  groups: int):
+    """One (sequence, kv-block) grid cell. q_ref: [H, D]; k/v_ref: the
+    pool block the index map selected via the prefetched block table,
+    [BS, Hkv, D]. Scratch persists across the sequential kv axis."""
+    b, j = pl.program_id(0), pl.program_id(1)
+    nblk = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    ctx = cl_ref[b]
+
+    @pl.when(j * block_size < ctx)
+    def _compute():
+        q = q_ref[...]                                  # [H, D]
+        k = k_ref[...]                                  # [BS, Hkv, D]
+        v = v_ref[...]
+        h, d = q.shape
+        hkv = k.shape[1]
+        qg = q.reshape(hkv, groups, d)
+        kt = jnp.transpose(k, (1, 0, 2))                # [Hkv, BS, D]
+        # batched over kv heads: [Hkv, G, D] x [Hkv, BS, D] -> [Hkv, G, BS]
+        s = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        s = s.reshape(h, block_size)
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (h, block_size), 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_scr[...][:, :1]                      # [H, 1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # [H, BS]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pg = p.reshape(hkv, groups, block_size)
+        vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, BS, D]
+        pv = jax.lax.dot_general(
+            pg.astype(v.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)         # [Hkv, G, D]
+        acc_scr[...] = alpha * acc_scr[...] + pv.reshape(h, d)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nblk - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        o_ref[...] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _paged_kernel_call(q, k_pool, v_pool, block_tables, context_lens, scale,
+                       interpret: bool):
+    b, h, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block_tables, context_lens
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((None, h, d), lambda b, j, bt, cl: (b, 0, 0)),
+            # the paged gather: the index map dereferences the block table
+            pl.BlockSpec((None, bs, hkv, d),
+                         lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)),
+            pl.BlockSpec((None, bs, hkv, d),
+                         lambda b, j, bt, cl: (bt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, h, d), lambda b, j, bt, cl: (b, 0, 0)),
+        scratch_shapes=[
+            _scratch((h, LANES)),
+            _scratch((h, LANES)),
+            _scratch((h, d)),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, scale=scale, block_size=bs,
+                               groups=h // hkv)
+    compiler_params = None
+    if pltpu is not None:
+        # jax <= 0.4.x spells it TPUCompilerParams; newer jax CompilerParams
+        cls = (getattr(pltpu, "CompilerParams", None)
+               or pltpu.TPUCompilerParams)
+        compiler_params = cls(dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                    scale: Optional[float] = None,
+                    use_kernel: Optional[bool] = None,
+                    interpret: Optional[bool] = None):
+    """Dispatching entry point (the mha() of the paged path).
+
+    use_kernel=None: Pallas kernel on TPU, XLA reference elsewhere —
+    the engine and model code call with defaults and get the right tier.
+    Tests pass use_kernel=True, interpret=True to validate the kernel's
+    numerics on CPU.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if use_kernel is None:
+        use_kernel = on_tpu
+    if not use_kernel:
+        return paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                         context_lens, scale=scale)
+    if interpret is None:
+        interpret = not on_tpu
+    return _paged_kernel_call(q, k_pool, v_pool, block_tables, context_lens,
+                              scale, interpret)

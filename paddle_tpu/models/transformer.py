@@ -179,6 +179,40 @@ class MultiHeadAttention(Module):
         out = self.out_proj(cx, out)
         return (out, cache) if cache is not None else (out, None)
 
+    def decode_paged(self, cx: Context, x, k_pool, v_pool, block_tables,
+                     context_lens, slots):
+        """Single-token decode through a PAGED KV cache (engine/ serving
+        path). x: [B, 1, D]; k_pool/v_pool: [NB, BS, Hkv, hd] shared block
+        pools; block_tables: [B, MB] int32; context_lens: [B] int32 valid
+        tokens per sequence INCLUDING this one; slots: [B] int32 flat pool
+        slot (block_id * BS + offset) where this token's k/v lands.
+        Returns (out [B, 1, D], (new_k_pool, new_v_pool)). Unlike the
+        dense `cache=` path, every sequence in the batch may sit at a
+        DIFFERENT position — the whole point of continuous batching."""
+        # self-scope like Embedding.attend: this is not routed through
+        # __call__, so the child scope must be entered by hand
+        cx = cx.scope(self._name or type(self).__name__)
+        if self.fused_qkv:
+            b = x.shape[0]
+            p = self.qkv(cx, x).reshape(       # head-major: [H, 3, hd]
+                b, 1, self.num_heads, 3, self.head_dim)
+            qh, kh, vh = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+        else:
+            qh = self._split(self.q_proj(cx, x))
+            kh = self._split_kv(self.k_proj(cx, x))
+            vh = self._split_kv(self.v_proj(cx, x))
+        nb, bs = k_pool.shape[:2]
+        flat = (nb * bs,) + k_pool.shape[2:]
+        k_pool = k_pool.reshape(flat).at[slots].set(
+            kh[:, 0].astype(k_pool.dtype)).reshape(k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[slots].set(
+            vh[:, 0].astype(v_pool.dtype)).reshape(v_pool.shape)
+        from paddle_tpu.kernels import paged_attention as paged
+        out = paged.paged_attention(qh[:, 0], k_pool, v_pool, block_tables,
+                                    context_lens)        # [B, H, hd]
+        out = self.out_proj(cx, out.reshape(x.shape[0], 1, self.model_dim))
+        return out, (k_pool, v_pool)
+
 
 class FeedForward(Module):
     def __init__(self, model_dim: int, hidden_dim: int, dropout: float = 0.1,
@@ -237,6 +271,22 @@ class DecoderLayer(Module):
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln3(cx, x)))
         return x, new_cache
+
+    def decode_paged(self, cx: Context, x, memory, k_pool, v_pool,
+                     block_tables, context_lens, slots, cross_mask=None):
+        """Paged self-attention decode step + dense cross-attention over
+        `memory` (encoder states stay dense — they are written once at
+        admission and never grow)."""
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        h, pools = self.self_attn.decode_paged(cx, self.ln1(cx, x), k_pool,
+                                               v_pool, block_tables,
+                                               context_lens, slots)
+        x = x + self.drop(cx, h)
+        h, _ = self.cross_attn(cx, self.ln2(cx, x), kv=memory,
+                               mask=cross_mask)
+        x = x + self.drop(cx, h)
+        x = x + self.drop(cx, self.ffn(cx, self.ln3(cx, x)))
+        return x, pools
 
 
 class Transformer(Module):
@@ -332,6 +382,26 @@ class Transformer(Module):
         logits = self.head(cx, self.dec_ln(cx, x))
         return logits[:, 0], new_caches
 
+    def decode_step_paged(self, cx: Context, token, positions, memory,
+                          pools, block_tables, context_lens, slots,
+                          src_mask=None):
+        """Continuous-batching decode for the encoder-decoder stack:
+        paged self-attention KV (per-layer (k_pool, v_pool) in `pools`),
+        per-sequence `positions` [B] int32, dense cross-attention over
+        `memory`. Returns (logits [B, V], new pools). The Transformer
+        analog of CausalLM.decode_step_paged."""
+        x = self.trg_embed(cx, token[:, None]) * math.sqrt(self.model_dim)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)
+        x = x + pe[positions.astype(jnp.int32)].astype(x.dtype)[:, None]
+        new_pools = []
+        for layer, (k_pool, v_pool) in zip(self.dec_layers, pools):
+            x, np_ = layer.decode_paged(cx, x, memory, k_pool, v_pool,
+                                        block_tables, context_lens, slots,
+                                        cross_mask=src_mask)
+            new_pools.append(np_)
+        logits = self.head(cx, self.dec_ln(cx, x))
+        return logits[:, 0], new_pools
+
 
 class CausalBlock(Module):
     """Pre-LN causal self-attention + FFN block (decoder-only stack —
@@ -359,6 +429,16 @@ class CausalBlock(Module):
         x = x + self.drop(cx, h)
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x, nc
+
+    def decode_paged(self, cx: Context, x, k_pool, v_pool, block_tables,
+                     context_lens, slots):
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        h, pools = self.attn.decode_paged(cx, self.ln1(cx, x), k_pool,
+                                          v_pool, block_tables,
+                                          context_lens, slots)
+        x = x + self.drop(cx, h)
+        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        return x, pools
 
 
 class CausalLM(Module):
@@ -465,6 +545,49 @@ class CausalLM(Module):
             x, nc = blk(cx, x, cache=cache, decode_pos=0, prefill=True)
             new_caches.append(nc)
         return self._head(cx, self.ln_f(cx, x[:, -1:]))[:, 0], new_caches
+
+    def prefill_paged(self, cx: Context, tokens, last_pos):
+        """Paged-serving prefill: tokens [B, Tpad] (right-padded prompts,
+        padding ignored by causal attention for real positions), last_pos
+        [B] int32 index of each prompt's final real token. Returns
+        (logits [B, V] at last_pos, per-layer (k, v) [B, Tpad, Hkv, hd])
+        — the engine scatters the k/v into its block pools (only real
+        positions get slots) and samples the first generated token from
+        the logits. Differs from `prefill` in that the last REAL position
+        is per-sequence, so ragged prompt batches share one padded call."""
+        b, t0 = tokens.shape
+        x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)[:t0]
+        x = x + pe.astype(x.dtype)[None]
+        kvs = []
+        for blk, cache in zip(self.blocks, init_kv_caches(self.blocks, b,
+                                                          t0)):
+            # prefill=True writes THIS call's k/v over the whole cache
+            # (decode_pos=0, full-length update), so nc IS the prompt k/v
+            x, nc = blk(cx, x, cache=cache, decode_pos=0, prefill=True)
+            kvs.append((nc["k"], nc["v"]))
+        hidden = self.ln_f(cx, x)
+        idx = last_pos.astype(jnp.int32)[:, None, None]
+        last_h = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)
+        return self._head(cx, last_h)[:, 0], kvs
+
+    def decode_step_paged(self, cx: Context, tokens, positions, pools,
+                          block_tables, context_lens, slots):
+        """Continuous-batching decode step: tokens [B] ids, positions [B]
+        int32 (PER-SEQUENCE positions — rows decode at different depths),
+        pools: per-layer (k_pool, v_pool) block pools, block_tables
+        [B, MB], context_lens [B] (= positions + 1), slots [B] flat pool
+        slots for this token's k/v. Returns (logits [B, V], new pools)."""
+        x = self.embed(cx, tokens[:, None]) * math.sqrt(self.model_dim)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)
+        x = x + pe[positions.astype(jnp.int32)].astype(x.dtype)[:, None]
+        new_pools = []
+        for blk, (k_pool, v_pool) in zip(self.blocks, pools):
+            x, np_ = blk.decode_paged(cx, x, k_pool, v_pool, block_tables,
+                                      context_lens, slots)
+            new_pools.append(np_)
+        return self._head(cx, self.ln_f(cx, x))[:, 0], new_pools
 
     def decode_step(self, cx: Context, token, pos, caches):
         """One step: token [B] ids at position `pos` -> (logits [B, V],

@@ -1,5 +1,7 @@
 """Test harnesses (numeric-gradient OpTest; reference op_test.py:43,414)."""
 
+from paddle_tpu.testing.fixtures import export_causal_lm, export_servable
 from paddle_tpu.testing.op_test import check_grad, check_output, numeric_grad
 
-__all__ = ["check_grad", "check_output", "numeric_grad"]
+__all__ = ["check_grad", "check_output", "numeric_grad",
+           "export_servable", "export_causal_lm"]

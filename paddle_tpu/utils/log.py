@@ -83,6 +83,31 @@ def resilience_event(evt: str, **fields) -> dict:
     return rec
 
 
+# -- serve event stream ------------------------------------------------------
+# The online inference engine's observability channel (ENGINE.md §events):
+# same single-line-JSON-on-stdout convention as the resilience stream so
+# serve_bench / log scrapers / tests all consume one format.
+
+_SERVE = logging.getLogger("paddle_tpu.serve")
+if not _SERVE.handlers:
+    _SERVE.addHandler(_StdoutHandler())
+    _SERVE.setLevel(logging.INFO)
+    _SERVE.propagate = False
+
+
+def serve_event(evt: str, **fields) -> dict:
+    """One single-line JSON serve record on stdout; returns the dict.
+
+    Canonical events: `serve_admit` (queue depth at admission),
+    `serve_prefill` / `serve_decode` (per-step batch shape + KV-cache
+    occupancy), `serve_preempt` (pool exhaustion eviction),
+    `serve_done` (per-request TTFT ms, decode tokens/sec, token count).
+    """
+    rec = {"evt": evt, **fields}
+    _SERVE.info(json.dumps(rec, sort_keys=False, default=str))
+    return rec
+
+
 class scoped_timer:
     """`with scoped_timer("phase"):` — logs wall time of the block at VLOG(1)."""
 

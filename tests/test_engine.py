@@ -1,0 +1,278 @@
+"""Online inference engine tests (engine/): allocator bookkeeping,
+continuous-batching == sequential decode identity, preemption-recompute
+correctness, streaming callbacks, serve events, saved-model round-trip.
+
+The load-bearing assertion is EXACT token identity, not closeness: the
+engine always runs its compiled steps at fixed padded shapes (decode at
+[max_batch_size], prefill at bucketed T), and rows of a batch are
+computed independently, so a request's tokens cannot depend on what
+else rode in the batch. `test_batched_equals_sequential` is that
+guarantee; `test_engine_matches_model_generate` pins the engine to the
+repo's reference decode path.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.engine import (CacheExhausted, PagedKVCache, Request,
+                               Scheduler, ServeEngine)
+from paddle_tpu.models.transformer import CausalLM
+
+pytestmark = pytest.mark.serve
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = CausalLM(vocab=VOCAB, model_dim=16, num_heads=4, num_layers=2,
+                     ffn_dim=32, dropout=0.0, max_len=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    return ServeEngine(model, variables, **kw)
+
+
+PROMPTS = [[5, 9, 2], [7, 1, 1, 3, 8], [4], [11, 12, 13, 14, 15, 16, 17]]
+
+
+# -- allocator ------------------------------------------------------------
+
+class TestPagedKVCache:
+    def test_alloc_free_roundtrip(self):
+        c = PagedKVCache(num_layers=1, num_blocks=9, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        assert c.free_blocks == 8          # block 0 reserved
+        c.alloc_sequence(1, 5)             # 2 blocks
+        c.alloc_sequence(2, 4)             # exact boundary: 1 block
+        assert c.used_blocks == 3
+        assert c.blocks_for(5) == 2 and c.blocks_for(4) == 1
+        assert c.free_sequence(1) == 2
+        assert c.free_sequence(2) == 1
+        assert c.free_blocks == 8
+
+    def test_append_crosses_block_boundary(self):
+        c = PagedKVCache(num_layers=1, num_blocks=4, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        c.alloc_sequence(7, 4)
+        assert c.used_blocks == 1
+        slot = c.append_token(7)           # position 4 -> new block
+        assert c.used_blocks == 2
+        assert slot == c.slot_of(7, 4)
+        assert slot % 4 == 0               # first slot of the new block
+        # append before advance is idempotent (same reservation)
+        assert c.append_token(7) == slot
+        c.advance(7)
+        assert c.seq_len(7) == 5
+
+    def test_exhaustion_raises_without_partial_alloc(self):
+        c = PagedKVCache(num_layers=1, num_blocks=3, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        c.alloc_sequence(1, 4)
+        with pytest.raises(CacheExhausted):
+            c.alloc_sequence(2, 12)        # needs 3, only 1 free
+        assert c.free_blocks == 1          # nothing leaked
+        assert c.can_allocate(4) and not c.can_allocate(5)
+
+    def test_block_zero_never_allocated(self):
+        c = PagedKVCache(num_layers=1, num_blocks=5, block_size=2,
+                         num_kv_heads=1, head_dim=4)
+        c.alloc_sequence(1, 8)             # all 4 allocatable blocks
+        assert 0 not in c.block_table(1)
+        assert c.padded_table(1, 6)[-2:] == [0, 0]   # padding IS block 0
+
+
+# -- scheduler ------------------------------------------------------------
+
+class TestScheduler:
+    def test_fifo_admission_under_budget(self):
+        c = PagedKVCache(num_layers=1, num_blocks=64, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
+        for p in ([1, 2, 3], [4, 5], [6]):
+            s.add(Request(prompt=list(p)))
+        kind, reqs = s.next_batch()
+        assert kind == "prefill"
+        assert [len(r.prompt) for r in reqs] == [3, 2]   # batch cap hit
+        assert s.queue_depth == 1
+        kind, reqs2 = s.next_batch()
+        assert kind == "decode" and len(reqs2) == 2      # admission full
+
+    def test_unschedulable_head_fails_loud(self):
+        """A head request that can NEVER admit (over the prefill budget
+        or bigger than the whole pool) must raise, not strand silently."""
+        c = PagedKVCache(num_layers=1, num_blocks=4, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
+        s.add(Request(prompt=list(range(16))))   # > budget and > pool
+        with pytest.raises(CacheExhausted, match="never"):
+            s.next_batch()
+
+    def test_preempt_requeues_front_with_folded_prompt(self):
+        c = PagedKVCache(num_layers=1, num_blocks=64, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        s = Scheduler(c, max_batch_size=2)
+        r = Request(prompt=[1, 2])
+        s.add(r)
+        s.next_batch()
+        r.generated = [9, 8]
+        s.preempt(r)
+        assert r.prompt == [1, 2, 9, 8] and r.generated == []
+        assert r.preempt_carry == 2 and r.preemptions == 1
+        assert s.waiting[0] is r and not s.running
+        assert c.free_blocks == 63
+
+
+# -- engine ---------------------------------------------------------------
+
+def _sequential(model, variables, prompts, n, **req_kw):
+    out = []
+    for p in prompts:
+        eng = _engine(model, variables)
+        out.append(eng.generate([p], max_new_tokens=n, **req_kw)[0])
+    return out
+
+
+def test_batched_equals_sequential(model_and_vars, capsys):
+    """THE continuous-batching guarantee: same tokens whether a request
+    shares the batch or runs alone."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    batched = eng.generate(PROMPTS, max_new_tokens=8)
+    assert batched == _sequential(model, variables, PROMPTS, 8)
+
+
+def test_engine_matches_model_generate(model_and_vars):
+    """Paged + continuous batching vs the dense-cache fori_loop decoder."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    for p, g in zip(PROMPTS, got):
+        want = model.generate(variables, jnp.asarray([p], jnp.int32), 8)
+        assert g == np.asarray(want)[0, len(p):].tolist()
+
+
+def test_sampled_decode_batch_invariant(model_and_vars):
+    """Stochastic sampling keys off (seed, position), so it too must be
+    batching-invariant."""
+    model, variables = model_and_vars
+    kw = dict(temperature=0.8, top_k=8, seed=123)
+    eng = _engine(model, variables)
+    batched = eng.generate(PROMPTS[:3], max_new_tokens=6, **kw)
+    assert batched == _sequential(model, variables, PROMPTS[:3], 6, **kw)
+    assert len(set(map(tuple, batched))) > 1   # actually sampling
+
+
+def test_preemption_recompute_exact(model_and_vars):
+    """A pool too small for all requests forces eviction; recompute must
+    reproduce the exact same tokens as an unconstrained run."""
+    model, variables = model_and_vars
+    prompts = [[5, 9, 2, 4], [7, 1, 1, 3], [4, 4, 2, 9]]
+    roomy = _engine(model, variables, max_batch_size=3)
+    want = roomy.generate(prompts, max_new_tokens=12)
+
+    tight = _engine(model, variables, max_batch_size=3, num_blocks=9)
+    got = tight.generate(prompts, max_new_tokens=12)
+    assert sum(r.preemptions for r in tight.finished.values()) > 0
+    assert got == want
+    assert tight.cache.used_blocks == 0       # everything returned
+
+
+def test_streaming_callbacks_in_order(model_and_vars):
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    streams = {}
+    reqs = []
+    for p in PROMPTS[:2]:
+        stream = []
+        reqs.append(eng.add_request(
+            p, max_new_tokens=5,
+            callback=(lambda s: s.append)(stream)))
+        streams[reqs[-1].req_id] = stream
+    done = eng.run()
+    for r in reqs:
+        assert streams[r.req_id] == done[r.req_id]    # streamed == final
+        assert len(streams[r.req_id]) == 5
+
+
+def test_serve_events_emitted(model_and_vars, capsys):
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    eng.generate(PROMPTS[:2], max_new_tokens=4)
+    events = [json.loads(line) for line in
+              capsys.readouterr().out.strip().splitlines()
+              if line.startswith('{"evt"')]
+    kinds = {e["evt"] for e in events}
+    assert {"serve_admit", "serve_prefill", "serve_decode",
+            "serve_done"} <= kinds
+    done = [e for e in events if e["evt"] == "serve_done"]
+    assert len(done) == 2
+    for e in done:
+        assert e["tokens"] == 4 and e["ttft_ms"] >= 0
+    decode = [e for e in events if e["evt"] == "serve_decode"]
+    assert all(0 <= e["occupancy"] <= 1 for e in decode)
+
+
+def test_oversize_prompt_rejected_at_intake(model_and_vars):
+    model, variables = model_and_vars
+    eng = _engine(model, variables, max_prefill_tokens=8)
+    with pytest.raises(ValueError, match="max_prefill_tokens"):
+        eng.add_request(list(range(10)))
+    roomy = _engine(model, variables)        # default prefill budget
+    with pytest.raises(ValueError, match="no room"):
+        roomy.add_request([1] * 64)          # max_seq_len is 64
+
+
+def test_eos_stops_early(model_and_vars):
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    free = eng.generate([[5, 9, 2]], max_new_tokens=8)[0]
+    # eos = a token whose FIRST occurrence is mid-stream, so the stop
+    # both triggers and truncates
+    eos = next(t for t in free if t != free[0])
+    cut = free.index(eos)
+    eng2 = _engine(model, variables)
+    req = eng2.add_request([5, 9, 2], max_new_tokens=8, eos_id=eos)
+    eng2.run()
+    assert req.generated == free[:cut + 1]
+    assert req.finish_reason == "eos"
+
+
+def test_from_saved_model_roundtrip(model_and_vars, tmp_path):
+    """Export with the manifest `serve` block, rebuild blind from disk,
+    and decode identically to the in-memory engine."""
+    from paddle_tpu.testing import export_causal_lm
+    path, model, variables = export_causal_lm(str(tmp_path / "m"))
+    eng = ServeEngine.from_saved_model(path, max_batch_size=2,
+                                       block_size=4, num_blocks=32)
+    want = _engine(model, variables, max_batch_size=2,
+                   block_size=4, num_blocks=32).generate(
+        [[3, 1, 4], [1, 5, 9, 2]], max_new_tokens=6)
+    got = eng.generate([[3, 1, 4], [1, 5, 9, 2]], max_new_tokens=6)
+    assert got == want
+
+
+def test_old_manifest_without_serve_block(model_and_vars, tmp_path):
+    """Pre-serve manifests stay loadable by the predictor, and the engine
+    fails with a clear message instead of a KeyError."""
+    from paddle_tpu.io.inference import (InferencePredictor,
+                                         save_inference_model)
+    model, variables = model_and_vars
+    x = jnp.zeros((1, 4), jnp.int32)
+    path = str(tmp_path / "old")
+    save_inference_model(path, model, variables, [x],
+                         input_names=["tokens"])        # no serve_meta
+    out = InferencePredictor(path).run([np.zeros((1, 4), np.int32)])
+    assert out[0].shape == (1, 4, VOCAB)
+    with pytest.raises(ValueError, match="serve"):
+        ServeEngine.from_saved_model(path)

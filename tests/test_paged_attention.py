@@ -1,0 +1,125 @@
+"""Paged decode attention vs the dense oracle.
+
+Reference bar: the block-table gather (kernels/paged_attention.py) must
+be numerically indistinguishable from dense attention over the same
+tokens — both the pure-XLA reference path and the Pallas kernel (run in
+interpret mode, same CPU-validation policy as tests/test_flash_selfcheck.py).
+Ragged shapes are the point: single-token sequences, lengths landing
+exactly on block boundaries, and mixed depths in one batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels.attention import reference_attention
+from paddle_tpu.kernels.paged_attention import (paged_attention,
+                                                paged_attention_reference)
+
+pytestmark = pytest.mark.serve
+
+
+def _pools_from_dense(k, v, block_size, num_blocks=None, seed=3):
+    """Scatter dense [B, T, Hkv, D] k/v into shuffled block pools and
+    return (k_pool, v_pool, block_tables). Shuffling the block ids is
+    deliberate: contiguous tables would hide gather/index bugs."""
+    b, t, hkv, d = k.shape
+    mb = -(-t // block_size)
+    num_blocks = num_blocks or (b * mb + 1)
+    rng = np.random.default_rng(seed)
+    ids = rng.permutation(np.arange(1, num_blocks))[:b * mb]
+    tables = ids.reshape(b, mb).astype(np.int32)
+    k_pool = np.zeros((num_blocks, block_size, hkv, d), k.dtype)
+    v_pool = np.zeros((num_blocks, block_size, hkv, d), v.dtype)
+    kp = np.zeros((b, mb * block_size, hkv, d), k.dtype)
+    vp = np.zeros((b, mb * block_size, hkv, d), v.dtype)
+    kp[:, :t], vp[:, :t] = np.asarray(k), np.asarray(v)
+    for i in range(b):
+        for j in range(mb):
+            k_pool[tables[i, j]] = kp[i, j * block_size:(j + 1) * block_size]
+            v_pool[tables[i, j]] = vp[i, j * block_size:(j + 1) * block_size]
+    return jnp.asarray(k_pool), jnp.asarray(v_pool), jnp.asarray(tables)
+
+
+def _dense_oracle(q, k, v, context_lens, scale=None):
+    """Per-sequence masked dense attention on the SAME tokens."""
+    t = k.shape[1]
+    mask = (jnp.arange(t)[None, :] < context_lens[:, None])[:, None, None, :]
+    return reference_attention(q[:, None], k, v, mask=mask,
+                               scale=scale)[:, 0]
+
+
+def _case(b, t, h, hkv, d, context_lens, block_size, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    cl = jnp.asarray(context_lens, jnp.int32)
+    k_pool, v_pool, tables = _pools_from_dense(k, v, block_size)
+    return q, k, v, cl, k_pool, v_pool, tables
+
+
+RAGGED_CASES = [
+    # (B, T, H, Hkv, D, context_lens, block_size)
+    (3, 16, 4, 4, 8, [1, 1, 1], 4),          # all single-token
+    (3, 16, 4, 4, 8, [4, 8, 16], 4),         # exact block boundaries
+    (4, 13, 4, 4, 8, [1, 4, 7, 13], 4),      # mixed depths, odd T
+    (2, 9, 8, 2, 16, [3, 9], 4),             # GQA 4:1
+    (2, 12, 4, 1, 8, [5, 12], 8),            # MQA
+]
+
+
+@pytest.mark.parametrize("b,t,h,hkv,d,lens,bs", RAGGED_CASES)
+def test_reference_matches_dense(b, t, h, hkv, d, lens, bs):
+    q, k, v, cl, k_pool, v_pool, tables = _case(b, t, h, hkv, d, lens, bs)
+    got = paged_attention_reference(q, k_pool, v_pool, tables, cl)
+    want = _dense_oracle(q, k, v, cl)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("b,t,h,hkv,d,lens,bs", RAGGED_CASES)
+def test_kernel_matches_reference(b, t, h, hkv, d, lens, bs):
+    """The Pallas kernel in interpret mode (CPU) against the oracle —
+    the acceptance bar from the paged-serving design: <= 1e-5 in fp32."""
+    q, k, v, cl, k_pool, v_pool, tables = _case(b, t, h, hkv, d, lens, bs)
+    got = paged_attention(q, k_pool, v_pool, tables, cl,
+                          use_kernel=True, interpret=True)
+    want = _dense_oracle(q, k, v, cl)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_dispatcher_reference_on_cpu():
+    """Defaults off-TPU must take the XLA reference path (no interpret
+    overhead in production CPU serving)."""
+    q, k, v, cl, k_pool, v_pool, tables = _case(2, 8, 4, 4, 8, [3, 8], 4)
+    got = paged_attention(q, k_pool, v_pool, tables, cl)
+    want = paged_attention_reference(q, k_pool, v_pool, tables, cl)
+    np.testing.assert_allclose(got, want, atol=0, rtol=0)
+
+
+def test_scratch_block_rows_are_inert():
+    """A padded batch row (all-zero table, context_len 1) must produce
+    finite output and not disturb real rows — the engine's fixed-shape
+    decode relies on this."""
+    q, k, v, cl, k_pool, v_pool, tables = _case(2, 8, 4, 4, 8, [3, 8], 4)
+    # row 2: dummy pointing at scratch block 0
+    q3 = jnp.concatenate([q, q[:1]], axis=0)
+    tables3 = jnp.concatenate(
+        [tables, jnp.zeros((1, tables.shape[1]), jnp.int32)], axis=0)
+    cl3 = jnp.concatenate([cl, jnp.ones((1,), jnp.int32)], axis=0)
+    got = paged_attention(q3, k_pool, v_pool, tables3, cl3,
+                          use_kernel=True, interpret=True)
+    assert bool(jnp.isfinite(got).all())
+    want = paged_attention(q, k_pool, v_pool, tables, cl,
+                           use_kernel=True, interpret=True)
+    np.testing.assert_allclose(got[:2], want, atol=0, rtol=0)
+
+
+def test_kernel_grad_free_path_jits():
+    """The kernel must be jit-compatible (the engine decode step wraps it)."""
+    q, k, v, cl, k_pool, v_pool, tables = _case(2, 8, 4, 4, 8, [3, 8], 4)
+    f = jax.jit(lambda *a: paged_attention(*a, use_kernel=False))
+    got = f(q, k_pool, v_pool, tables, cl)
+    want = _dense_oracle(q, k, v, cl)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)

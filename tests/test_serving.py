@@ -14,7 +14,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu  # noqa: F401  (registers ml_dtypes, loads jax on CPU)
-from paddle_tpu.io.inference import InferencePredictor, save_inference_model
+from paddle_tpu.io.inference import InferencePredictor
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -27,13 +27,14 @@ def _site_packages() -> str:
 @pytest.fixture(scope="module")
 def model_dir(tmp_path_factory):
     from paddle_tpu.models import MLP
+    from paddle_tpu.testing import export_servable
     import jax.numpy as jnp
     model = MLP(hidden=(8,), num_classes=3)
     x = jnp.zeros((4, 6), jnp.float32)
     variables = model.init(0, x)
-    path = str(tmp_path_factory.mktemp("serving") / "model")
-    save_inference_model(path, model, variables, [x], input_names=["x"])
-    return path
+    return export_servable(
+        str(tmp_path_factory.mktemp("serving") / "model"),
+        model, variables, [x], input_names=["x"])
 
 
 def test_cpredictor_matches_python(model_dir):
