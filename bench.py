@@ -1,4 +1,11 @@
-"""Benchmark entrypoint (driver contract: prints ONE JSON line).
+"""Benchmark entrypoint (driver contract: a parseable primary-metric
+JSON line, whatever happens).
+
+The primary line prints TWICE: once the moment the primary metric is
+measured (flushed, with `extra.partial: true`, before any optional
+entry can run long) and once complete at the end — so a driver timeout
+mid-extras still leaves a parseable line, and a finished run's last
+line carries everything.
 
 Primary metric: ResNet-50 training throughput (imgs/s, bs=64) — the
 reference's headline trainable-model metric (BASELINE.md: 81.69 imgs/s on
@@ -27,13 +34,17 @@ import tempfile
 import time
 
 # Soft wall-clock budget: optional entries are skipped (with a marker)
-# once exceeded, so the primary metric always prints well inside any
-# driver timeout. Override with PTPU_BENCH_BUDGET_S. The anchor rides
-# PTPU_BENCH_T0 across the backend-init re-exec (time.time, not
-# monotonic: the epoch must survive the process boundary) so retries
-# spend from the SAME budget rather than resetting it.
+# once exceeded, and even required entries stop starting once the
+# budget is SPENT, so the run always finishes inside any sane driver
+# timeout. The default sits well under the shortest observed driver
+# kill (r5 artifact: rc=124 with the JSON line unprinted because the
+# required set + a budget extension overran it). Override with
+# PTPU_BENCH_BUDGET_S. The anchor rides PTPU_BENCH_T0 across the
+# backend-init re-exec (time.time, not monotonic: the epoch must
+# survive the process boundary) so retries spend from the SAME budget
+# rather than resetting it.
 _T0 = float(os.environ.setdefault("PTPU_BENCH_T0", str(time.time())))
-_BUDGET_S = float(os.environ.get("PTPU_BENCH_BUDGET_S", "1500"))
+_BUDGET_S = float(os.environ.get("PTPU_BENCH_BUDGET_S", "900"))
 
 
 def _elapsed() -> float:
@@ -671,6 +682,19 @@ def main():
         "timed_steps": resnet.steps,
     }
 
+    # DRIVER CONTRACT: the primary metric prints the moment it exists,
+    # flushed, BEFORE any optional entry can run long — a driver
+    # timeout (r1/r5 artifacts: rc=124, parsed:null) then still finds a
+    # parseable line. The complete line prints again at the end; a
+    # consumer taking either the first or the last JSON line gets the
+    # same primary metric.
+    print(json.dumps({
+        "metric": f"resnet50_train_imgs_per_sec_bs{bs}",
+        "value": round(resnet.value, 2), "unit": "imgs/s",
+        "vs_baseline": round(resnet.vs_baseline, 3),
+        "extra": dict(extra, partial=True),
+    }), flush=True)
+
     try:
         # winning config from the r4 tools/profile_transformer.py sweep:
         # raw_ce (bf16 logits straight into the promoting CE) at bs=32 —
@@ -689,15 +713,22 @@ def main():
     except Exception as e:  # primary metric must still print
         extra["transformer_error"] = f"{type(e).__name__}: {e}"[:200]
 
-    # Entry gate. required=True entries are the NEVER-SKIP set (r4
-    # VERDICT missing #1: the artifact must carry everything the README
-    # claims — decode, s2d, infer, sustained_matmul, scaling, plus the
-    # flash correctness gate); optional entries check the soft budget so
-    # a slow day degrades to fewer extras, never to a missing line.
+    # Entry gate. required=True entries are the priority set (r4
+    # VERDICT missing #1: the artifact should carry everything the
+    # README claims — decode, s2d, infer, sustained_matmul, scaling,
+    # plus the flash correctness gate): they ignore the per-entry
+    # estimate and only stop once the budget is actually SPENT — on a
+    # pathologically slow day they too must yield rather than run into
+    # the driver's kill (r5 artifact: rc=124, no JSON line). Optional
+    # entries check the soft budget up front so a slow day degrades to
+    # fewer extras first.
     def _gate(key, est_s=120.0, tpu_only=True, required=False):
         if tpu_only and not on_tpu:
             return False
-        if required or _budget_ok(est_s):
+        if required:
+            if _elapsed() < _BUDGET_S:
+                return True
+        elif _budget_ok(est_s):
             return True
         extra[f"{key}_skipped"] = "bench budget"
         return False
@@ -782,18 +813,10 @@ def main():
             extra["int8_error"] = f"{type(e).__name__}: {e}"[:160]
 
     # ---- optional extras, most important first --------------------------
-    # The never-skip set ignores the soft budget and can consume all of
-    # it on a slow pool day; guarantee the top optionals (bert, moe,
-    # longcontext — all README-referenced; gate estimates sum to 480s)
-    # a post-required allowance so "required ran long" degrades the
-    # tail, not the headlines. ONLY when the operator did not pin the
-    # budget explicitly: an explicit PTPU_BENCH_BUDGET_S means a hard
-    # external deadline, and overshooting it risks the driver killing
-    # the run before the one JSON line prints — worse than any skip.
-    global _BUDGET_S
-    if "PTPU_BENCH_BUDGET_S" not in os.environ:
-        _BUDGET_S = max(_BUDGET_S, _elapsed() + 480)
-
+    # (The r4-era "extend the budget after the required set" hack is
+    # gone: it pushed total wall time past the driver's kill and cost
+    # the r5 artifact its primary line. The budget is ONE fixed ceiling;
+    # required entries drain it first, optionals get what remains.)
     if _gate("bert"):  # BERT-base MLM (BASELINE BERT row)
         try:
             b = _retry(lambda: run_model("bert", batch_size=64,
@@ -861,13 +884,16 @@ def main():
             except Exception as e:
                 extra[f"{name}_error"] = f"{type(e).__name__}: {e}"[:160]
 
-    # collect the CPU-mesh weak-scaling sweep (never skipped: on TPU it
-    # ran concurrently with everything above; on CPU it runs now,
-    # sequentially, so it never contended with the timed entries)
+    # collect the CPU-mesh weak-scaling sweep (on TPU it ran
+    # concurrently with everything above; on CPU it runs now,
+    # sequentially, so it never contended with the timed entries). The
+    # join is bounded by the REMAINING budget: a wedged subprocess must
+    # not hold the final JSON line past the driver timeout.
     try:
         if scaling_proc is None:
             scaling_proc = _scaling_subprocess_start()
-        extra.update(_scaling_subprocess_join(scaling_proc))
+        extra.update(_scaling_subprocess_join(
+            scaling_proc, timeout=max(30.0, _BUDGET_S - _elapsed())))
     except Exception as e:
         extra["scaling_error"] = f"{type(e).__name__}: {e}"[:160]
 
@@ -878,7 +904,7 @@ def main():
         "vs_baseline": round(resnet.vs_baseline, 3),
         "extra": extra,
     }
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,10 +1,11 @@
 """ServeEngine: the online inference serve loop.
 
-Ties the subsystem together (ENGINE.md): a `PagedKVCache` holds KV
-state in block pools, a `Scheduler` plans one prefill or decode batch
-per step, and this engine compiles + executes the steps, samples
-tokens host-side, streams them to per-request callbacks, and emits
-structured `serve_event` JSON (utils/log.py) for observability.
+Ties the subsystem together (ENGINE.md): a refcounted `PagedKVCache`
+holds KV state in block pools (prefix-shared, copy-on-write), a
+`Scheduler` plans one prefill-chunk or decode batch per step, and this
+engine compiles + executes the steps, samples tokens host-side,
+streams them to per-request callbacks, and emits structured
+`serve_event` JSON (utils/log.py) for observability.
 
 Shape discipline — the one-compilation rule: continuous batching
 mutates batch membership every step, which naively means a fresh XLA
@@ -13,14 +14,23 @@ compile every step. Instead every device call runs at a FIXED shape:
 - decode is always [max_batch_size] rows; empty rows are padding that
   reads/writes the reserved scratch block 0 (context_len 1, slot 0) so
   they can never touch a live sequence. One compile, ever.
-- prefill is always [max_batch_size, T] with T bucketed to the next
-  power of two — one compile per bucket, O(log max_seq_len) total.
+- prefill chunks are always [max_batch_size, C] with C bucketed to the
+  next power of two — one compile per bucket, O(log chunk_budget)
+  total. A prefix-cache hit or a chunk boundary only changes the
+  row's start offset (an int32 operand), never the shape.
+- COW block copies run through one fixed-width compiled
+  gather/scatter (`_copy_blocks`); unused lanes copy scratch block 0
+  onto itself.
 
 Padding rows cost FLOPs but rows of a batch are computed independently
 by every op in the model, so a request's logits are bit-identical
 whether it shares the batch or runs alone — this is what makes
 continuous batching safe to verify token-for-token against sequential
-decode (tests/test_engine.py), not just "close".
+decode (tests/test_engine.py), not just "close". Prefix sharing keeps
+the same guarantee: a shared block's KV was computed from the same
+tokens at the same positions by the same compiled chunk step, and
+masked attention lanes underflow to exact zero, so reusing it is
+bit-identical to recomputing it (tests/test_prefix_cache.py).
 
 Sampling runs on host from the [B, V] logits (greedy / temperature /
 top-k). Stochastic sampling derives its rng stream from
@@ -31,7 +41,7 @@ scheduling decisions can't change a request's output.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +49,10 @@ import numpy as np
 
 from paddle_tpu.core.module import Context, _CtxCore
 from paddle_tpu.engine.paged_cache import PagedKVCache
-from paddle_tpu.engine.scheduler import Request, Scheduler
+from paddle_tpu.engine.scheduler import PrefillChunk, Request, Scheduler
 from paddle_tpu.utils.log import serve_event
+
+_COPY_LANES = 8     # COW copies flushed through one fixed-shape call
 
 
 def _fresh_cx(variables) -> Context:
@@ -97,16 +109,21 @@ class ServeEngine:
     """Continuous-batching serve loop over a CausalLM.
 
     add_request() enqueues; step() advances the world by one scheduler
-    plan (one prefill or decode batch); run() drains the queue. Token
-    callbacks fire as tokens are sampled — streaming falls out of
+    plan (one prefill-chunk or decode batch); run() drains the queue.
+    Token callbacks fire as tokens are sampled — streaming falls out of
     iteration-level scheduling for free.
-    """
+
+    `max_prefill_tokens` is the per-step CHUNK budget: prompts longer
+    than it are admitted anyway and prefilled across several steps,
+    interleaved with decode steps. `enable_prefix_cache=False` turns
+    off block sharing (the serve_bench baseline)."""
 
     def __init__(self, model, variables, max_batch_size: int = 4,
                  block_size: int = 16, num_blocks: int = 256,
                  max_seq_len: Optional[int] = None,
                  max_prefill_tokens: int = 512,
-                 min_prefill_bucket: int = 16):
+                 min_prefill_bucket: int = 16,
+                 enable_prefix_cache: bool = True):
         self.model = model
         self.variables = variables
         attn = model.blocks[0].attn
@@ -116,7 +133,8 @@ class ServeEngine:
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
-            head_dim=attn.head_dim, dtype=model.dtype)
+            head_dim=attn.head_dim, dtype=model.dtype,
+            enable_prefix_cache=enable_prefix_cache)
         self.max_blocks_per_seq = self.cache.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(
             self.cache, max_batch_size=max_batch_size,
@@ -125,26 +143,18 @@ class ServeEngine:
         self.scheduler.on_preempt = self._on_preempt
         self.finished: Dict[int, Request] = {}
         self.steps = 0
+        self.prefill_tokens_computed = 0
+        self.peak_occupancy = 0.0
+        self.max_chunk_tokens = 0       # largest prefill step actually run
 
         model_ = model
 
         @jax.jit
-        def _prefill(variables, tokens, last_pos):
-            logits, kvs = model_.prefill_paged(_fresh_cx(variables), tokens,
-                                               last_pos)
-            return logits, kvs
-
-        @jax.jit
-        def _scatter(pools, kvs, slots):
-            new_pools = []
-            for (kp, vp), (k, v) in zip(pools, kvs):
-                flat = (kp.shape[0] * kp.shape[1],) + kp.shape[2:]
-                kf = k.reshape((-1,) + k.shape[2:]).astype(kp.dtype)
-                vf = v.reshape((-1,) + v.shape[2:]).astype(vp.dtype)
-                new_pools.append((
-                    kp.reshape(flat).at[slots].set(kf).reshape(kp.shape),
-                    vp.reshape(flat).at[slots].set(vf).reshape(vp.shape)))
-            return new_pools
+        def _prefill_chunk(variables, tokens, start_pos, pools,
+                           block_tables, context_lens, slots, last_idx):
+            return model_.prefill_chunk_paged(
+                _fresh_cx(variables), tokens, start_pos, pools,
+                block_tables, context_lens, slots, last_idx)
 
         @jax.jit
         def _decode(variables, tokens, positions, pools, block_tables,
@@ -153,9 +163,16 @@ class ServeEngine:
                 _fresh_cx(variables), tokens, positions, pools,
                 block_tables, context_lens, slots)
 
-        self._prefill = _prefill
-        self._scatter = _scatter
+        @jax.jit
+        def _copy_blocks(pools, src, dst):
+            # COW replay: dst blocks take src blocks' contents, every
+            # layer; padding lanes are (0, 0) — scratch onto itself
+            return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
+                    for kp, vp in pools]
+
+        self._prefill_chunk = _prefill_chunk
         self._decode = _decode
+        self._copy_blocks = _copy_blocks
 
     # -- construction from an exported artifact ---------------------------
     @classmethod
@@ -198,10 +215,11 @@ class ServeEngine:
         if len(prompt) + 1 > self.max_seq_len:
             raise ValueError(f"prompt len {len(prompt)} leaves no room to "
                              f"generate under max_seq_len {self.max_seq_len}")
-        if len(prompt) > self.scheduler.max_prefill_tokens:
+        if self.cache.blocks_for(len(prompt) + 1) > self.cache.num_blocks - 1:
             raise ValueError(
-                f"prompt len {len(prompt)} exceeds max_prefill_tokens "
-                f"{self.scheduler.max_prefill_tokens}; it could never admit")
+                f"prompt len {len(prompt)} cannot fit the KV pool even "
+                f"alone ({self.cache.num_blocks - 1} blocks of "
+                f"{self.cache.block_size}); raise num_blocks")
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, seed=seed,
                       eos_id=eos_id, callback=callback)
@@ -218,12 +236,14 @@ class ServeEngine:
         plan = self.scheduler.next_batch()
         if plan is None:
             return False
-        kind, reqs = plan
+        kind, work = plan
         self.steps += 1
         if kind == "prefill":
-            self._step_prefill(reqs)
+            self._step_prefill(work)
         else:
-            self._step_decode(reqs)
+            self._step_decode(work)
+        self.peak_occupancy = max(self.peak_occupancy,
+                                  self.cache.occupancy())
         return True
 
     def run(self) -> Dict[int, List[int]]:
@@ -234,37 +254,74 @@ class ServeEngine:
                 for rid, r in self.finished.items()}
 
     # -- internals ---------------------------------------------------------
-    def _step_prefill(self, reqs: List[Request]) -> None:
+    def _flush_cow(self) -> None:
+        """Replay queued copy-on-write block copies on the device pools
+        BEFORE the step that writes the fresh blocks, through one
+        fixed-shape compiled call per _COPY_LANES batch."""
+        copies = self.cache.drain_copies()
+        for i in range(0, len(copies), _COPY_LANES):
+            batch = copies[i:i + _COPY_LANES]
+            src = np.zeros((_COPY_LANES,), np.int32)
+            dst = np.zeros((_COPY_LANES,), np.int32)
+            for j, (s, d) in enumerate(batch):
+                src[j], dst[j] = s, d
+            self.cache.pools = self._copy_blocks(
+                self.cache.pools, jnp.asarray(src), jnp.asarray(dst))
+
+    def _step_prefill(self, chunks: List[PrefillChunk]) -> None:
+        self._flush_cow()
         n = self.max_batch_size
-        t_real = max(len(r.tokens) for r in reqs)
-        t_pad = max(_next_pow2(t_real), self.min_prefill_bucket)
-        t_pad = min(t_pad, self.model.max_len)   # bucket cap: pe table length
-        tokens = np.zeros((n, t_pad), np.int32)
-        last_pos = np.zeros((n,), np.int32)
-        # padded rows / positions scatter into scratch block 0 (slot < bs)
-        slots = np.zeros((n * t_pad,), np.int32)
-        for i, r in enumerate(reqs):
-            toks = r.tokens
-            tokens[i, :len(toks)] = toks
-            last_pos[i] = len(toks) - 1
-            for p in range(len(toks)):
-                slots[i * t_pad + p] = self.cache.slot_of(r.req_id, p)
-        logits, kvs = self._prefill(self.variables, jnp.asarray(tokens),
-                                    jnp.asarray(last_pos))
-        self.cache.pools = self._scatter(self.cache.pools, kvs,
-                                         jnp.asarray(slots))
+        mb = self.max_blocks_per_seq
+        c_real = max(ch.length for ch in chunks)
+        c_pad = max(_next_pow2(c_real), self.min_prefill_bucket)
+        c_pad = min(c_pad, self.model.max_len)   # bucket cap: pe table
+        tokens = np.zeros((n, c_pad), np.int32)
+        start_pos = np.zeros((n,), np.int32)
+        last_idx = np.zeros((n,), np.int32)
+        context_lens = np.ones((n,), np.int32)   # pad rows: scratch slot 0
+        block_tables = np.zeros((n, mb), np.int32)
+        # pad rows / positions scatter into scratch block 0 (slot < bs)
+        slots = np.zeros((n * c_pad,), np.int32)
+        for i, ch in enumerate(chunks):
+            toks = ch.req.tokens
+            tokens[i, :ch.length] = toks[ch.start:ch.start + ch.length]
+            start_pos[i] = ch.start
+            last_idx[i] = ch.length - 1
+            context_lens[i] = ch.start + ch.length
+            block_tables[i] = self.cache.padded_table(ch.req.req_id, mb)
+            for p in range(ch.length):
+                slots[i * c_pad + p] = self.cache.slot_of(ch.req.req_id,
+                                                          ch.start + p)
+        logits, self.cache.pools = self._prefill_chunk(
+            self.variables, jnp.asarray(tokens), jnp.asarray(start_pos),
+            self.cache.pools, jnp.asarray(block_tables),
+            jnp.asarray(context_lens), jnp.asarray(slots),
+            jnp.asarray(last_idx))
         logits = np.asarray(logits)
+        computed = sum(ch.length for ch in chunks)
+        cached = sum(ch.req.cached_tokens for ch in chunks
+                     if ch.start == ch.req.cached_tokens)
+        self.prefill_tokens_computed += computed
+        self.max_chunk_tokens = max(self.max_chunk_tokens, computed)
         now = time.monotonic()
-        for i, r in enumerate(reqs):
-            tok = _sample(logits[i], r, len(r.tokens))
-            if not r.first_token_time:
-                r.first_token_time = now
-            self._emit_token(r, tok)
-        serve_event("serve_prefill", batch=len(reqs), padded_t=t_pad,
-                    step=self.steps, occupancy=round(self.cache.occupancy(), 4),
+        for i, ch in enumerate(chunks):
+            r = ch.req
+            self.cache.commit_prefill(r.req_id, ch.start + ch.length)
+            if ch.start + ch.length == len(r.prompt):   # final chunk
+                tok = _sample(logits[i], r, len(r.prompt))
+                if not r.first_token_time:
+                    r.first_token_time = now
+                self._emit_token(r, tok)
+        serve_event("serve_prefill", batch=len(chunks), padded_t=c_pad,
+                    tokens=computed, cached=cached, step=self.steps,
+                    cow=self.cache.cow_copies,
+                    shared_blocks=self.cache.shared_blocks,
+                    hit_rate=round(self.cache.hit_rate(), 4),
+                    occupancy=round(self.cache.occupancy(), 4),
                     queue_depth=self.scheduler.queue_depth)
 
     def _step_decode(self, reqs: List[Request]) -> None:
+        self._flush_cow()
         b = self.max_batch_size
         mb = self.max_blocks_per_seq
         tokens = np.zeros((b,), np.int32)
@@ -285,7 +342,8 @@ class ServeEngine:
             jnp.asarray(context_lens), jnp.asarray(slots))
         logits = np.asarray(logits)
         for i, r in enumerate(reqs):
-            self.cache.advance(r.req_id)
+            # the step wrote r.generated[-1]'s k/v at the reserved slot
+            self.cache.advance(r.req_id, r.generated[-1])
             tok = _sample(logits[i], r, self.cache.seq_len(r.req_id))
             self._emit_token(r, tok)
         serve_event("serve_decode", batch=len(reqs), step=self.steps,
@@ -311,12 +369,36 @@ class ServeEngine:
         serve_event("serve_done", req_id=req.req_id, reason=reason,
                     tokens=n_gen, ttft_ms=round(ttft_ms, 3),
                     decode_tok_s=round(max(n_gen - 1, 0) / decode_s, 2),
+                    cached_tokens=req.cached_tokens,
                     preemptions=req.preemptions)
 
     def _on_preempt(self, req: Request) -> None:
         serve_event("serve_preempt", req_id=req.req_id,
                     kept_tokens=len(req.prompt),
                     occupancy=round(self.cache.occupancy(), 4))
+
+    # -- observability -----------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        """Cumulative serve counters: prefix-cache hit rate, prefill
+        tokens actually computed, COW/shared block counts, peak block
+        occupancy. The serve_bench verdicts key off these."""
+        out = self.cache.stats()
+        out.update({
+            "prefill_tokens_computed": self.prefill_tokens_computed,
+            "peak_occupancy": round(self.peak_occupancy, 4),
+            "max_chunk_tokens": self.max_chunk_tokens,
+            "steps": self.steps,
+        })
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the cumulative counters (after a warmup drain) without
+        touching compiled steps or live state."""
+        self.cache.reset_stats()
+        self.prefill_tokens_computed = 0
+        self.peak_occupancy = 0.0
+        self.max_chunk_tokens = 0
+        self.steps = 0
 
     # -- convenience --------------------------------------------------------
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,

@@ -1,4 +1,4 @@
-"""PagedKVCache: block-pool KV storage for online inference.
+"""PagedKVCache: refcounted block-pool KV storage with prefix sharing.
 
 The HBM side of continuous batching (ENGINE.md): instead of one dense
 [B, Tmax, Hkv, hd] cache per batch slot — which reserves worst-case
@@ -10,12 +10,37 @@ appends a block from the free list, finishing/evicting one returns its
 blocks in O(blocks). Fragmentation is bounded at block_size-1 wasted
 slots per sequence, and admission capacity is a pure free-list check.
 
+Prefix sharing (vLLM-style): blocks carry REFCOUNTS, and every FULL
+block whose KV content is actually in the pool is registered in a
+prefix index keyed by the exact token tuple of the sequence prefix it
+ends (collision-free by construction — the key IS the content, not a
+hash of it). `alloc_sequence` walks a new prompt block by block
+through the index and reuses matching blocks instead of allocating:
+a hit means those tokens' KV already exists, so the engine skips their
+prefill compute AND their HBM. Because only committed-full blocks are
+shareable, a shared block is write-immutable in the common case; the
+one legal write into a shared block (a full-prompt hit is capped at
+n-1 so the last token always recomputes for logits, landing mid-block)
+triggers COPY-ON-WRITE: the writer gets a fresh private block and the
+engine replays the old block's contents into it on device
+(`drain_copies` -> the engine's compiled gather/scatter).
+
+Freed blocks stay CACHED-FREE: when the last reference drops, the
+block returns to the free list but keeps its prefix-index entry, so a
+later request with the same prefix (the shared-system-prompt pattern)
+revives it from the free list instead of recomputing — the KV is
+still sitting in the pool untouched. The entry is evicted lazily, only
+when `_pop_free` hands the block out for fresh content; frees append
+to the right and pops take from the left, so the longest-freed cached
+content is recycled first (FIFO ~ LRU here).
+
 Host/device split: this class is the HOST-side allocator + bookkeeping
-(free list, per-sequence tables, lengths). The device-side pools are
-jnp arrays held in `self.pools` and are updated FUNCTIONALLY — the
-jitted prefill-scatter / decode step return new pool arrays and the
-engine assigns them back. Nothing here traces into XLA; block tables
-cross into jit as plain int32 operands.
+(free list, refcounts, per-sequence tables/lengths/tokens, prefix
+index). The device-side pools are jnp arrays held in `self.pools` and
+are updated FUNCTIONALLY — the jitted prefill-scatter / decode step /
+COW block copy return new pool arrays and the engine assigns them
+back. Nothing here traces into XLA; block tables cross into jit as
+plain int32 operands.
 
 Block 0 is reserved as a scratch block: padded batch rows (the engine
 pads decode batches to a fixed size for one-compilation serving) write
@@ -26,7 +51,7 @@ sequence.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -36,7 +61,7 @@ class CacheExhausted(Exception):
 
 
 class PagedKVCache:
-    """Block-pool KV cache shared by all layers of one model.
+    """Refcounted block-pool KV cache shared by all layers of one model.
 
     All layers allocate in lockstep (a token occupies the same slot in
     every layer's pool), so ONE free list / block table set serves the
@@ -44,7 +69,8 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
-                 num_kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 num_kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 enable_prefix_cache: bool = True):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
         self.num_blocks = num_blocks
@@ -52,6 +78,7 @@ class PagedKVCache:
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.enable_prefix_cache = enable_prefix_cache
         shape = (num_blocks, block_size, num_kv_heads, head_dim)
         self.pools: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
             (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
@@ -60,6 +87,24 @@ class PagedKVCache:
         self._free = deque(range(1, num_blocks))
         self._tables: Dict[int, List[int]] = {}
         self._lens: Dict[int, int] = {}
+        # token ids backing each reserved position (the content identity
+        # the prefix index is keyed on)
+        self._tokens: Dict[int, List[int]] = {}
+        # prefix length per sequence whose KV is actually IN the pool —
+        # alloc reserves blocks for the whole prompt up front, but their
+        # content arrives chunk by chunk; only committed-full blocks are
+        # shareable (a hit must never read a block whose scatter is
+        # still queued behind it in the schedule)
+        self._committed: Dict[int, int] = {}
+        self._refs: Dict[int, int] = {}               # block -> refcount
+        # full-prefix token tuple -> block holding that prefix's last block
+        self._index: Dict[tuple, int] = {}
+        self._key_of: Dict[int, tuple] = {}           # block -> index key
+        self._pending_copies: List[Tuple[int, int]] = []   # (src, dst)
+        # cumulative stats (serve_event / bench verdicts)
+        self.hit_tokens = 0
+        self.prompt_tokens = 0
+        self.cow_copies = 0
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -68,7 +113,19 @@ class PagedKVCache:
 
     @property
     def used_blocks(self) -> int:
+        """DISTINCT allocated blocks — sharing shows up as lower usage."""
         return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def shared_blocks(self) -> int:
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    @property
+    def total_refs(self) -> int:
+        return sum(self._refs.values())
+
+    def ref_count(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
     def occupancy(self) -> float:
         """Fraction of allocatable blocks in use (serve_event metric)."""
@@ -77,48 +134,185 @@ class PagedKVCache:
     def blocks_for(self, num_tokens: int) -> int:
         return -(-num_tokens // self.block_size)
 
-    def can_allocate(self, num_tokens: int) -> bool:
-        return self.blocks_for(num_tokens) <= len(self._free)
+    def _pop_free(self) -> int:
+        """Take a block for FRESH content, lazily evicting any stale
+        cached-free index entry it still carries (freed blocks keep
+        their prefix KV reusable until the pool actually needs them —
+        free_sequence appends to the RIGHT and this pops from the LEFT,
+        so the longest-freed cached content is evicted first)."""
+        block = self._free.popleft()
+        key = self._key_of.pop(block, None)
+        if key is not None and self._index.get(key) == block:
+            del self._index[key]
+        return block
+
+    def _match_prefix(self, tokens: Sequence[int]) -> List[int]:
+        """Longest run of committed full blocks matching `tokens`' head
+        (read-only: no refs taken)."""
+        if not self.enable_prefix_cache:
+            return []
+        matched: List[int] = []
+        bs = self.block_size
+        for end in range(bs, len(tokens) + 1, bs):
+            block = self._index.get(tuple(tokens[:end]))
+            if block is None:
+                break
+            matched.append(block)
+        return matched
+
+    def can_allocate(self, tokens) -> bool:
+        """Admission check. `tokens` may be a token list (prefix-aware:
+        matched blocks cost nothing beyond their own revival) or a bare
+        count (conservative)."""
+        if isinstance(tokens, int):
+            return self.blocks_for(tokens) <= len(self._free)
+        matched = self._match_prefix(tokens)
+        need = self.blocks_for(len(tokens)) - len(matched)
+        # cached-free matches leave the free list too (revival)
+        revive = sum(1 for b in matched if b not in self._refs)
+        return need + revive <= len(self._free)
 
     # -- sequence lifecycle ----------------------------------------------
-    def alloc_sequence(self, seq_id: int, num_tokens: int) -> None:
-        """Reserve blocks for a sequence's first num_tokens (prefill).
-        Raises CacheExhausted (allocating nothing) when the free list is
-        short — the scheduler turns that into deferred admission or
-        preemption."""
+    def alloc_sequence(self, seq_id: int, tokens: Sequence[int]) -> int:
+        """Reserve blocks for a sequence's prompt, reusing committed
+        prefix blocks from the index. Returns the number of CACHED
+        tokens (KV already in the pool — the engine prefills only the
+        suffix). A full-prompt hit is capped at n-1 so the last token
+        always recomputes (its logits seed sampling); that write lands
+        inside a shared block and COWs it. Raises CacheExhausted
+        (allocating nothing) when the free list is short — the
+        scheduler turns that into deferred admission or preemption."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
-        need = self.blocks_for(num_tokens)
-        if need > len(self._free):
+        n = len(tokens)
+        matched = self._match_prefix(tokens)
+        need = self.blocks_for(n) - len(matched)
+        revive = [b for b in matched if b not in self._refs]
+        if need + len(revive) > len(self._free):
             raise CacheExhausted(
-                f"need {need} blocks, {len(self._free)} free")
-        self._tables[seq_id] = [self._free.popleft() for _ in range(need)]
-        self._lens[seq_id] = num_tokens
+                f"need {need + len(revive)} blocks, {len(self._free)} free")
+        for b in matched:
+            if b in self._refs:
+                self._refs[b] += 1
+            else:                       # cached-free hit: revive the block
+                self._free.remove(b)
+                self._refs[b] = 1
+        fresh = [self._pop_free() for _ in range(need)]
+        for b in fresh:
+            self._refs[b] = 1
+        self._tables[seq_id] = matched + fresh
+        self._lens[seq_id] = n
+        self._tokens[seq_id] = list(tokens)
+        cached = min(len(matched) * self.block_size, n - 1)
+        self._committed[seq_id] = cached
+        self.hit_tokens += cached
+        self.prompt_tokens += n
+        return cached
+
+    def ensure_writable(self, seq_id: int, start: int, end: int) -> None:
+        """Copy-on-write pass before the engine scatters positions
+        [start, end): every touched block with refcount > 1 is swapped
+        for a fresh private block and an on-device (src, dst) block
+        copy is queued (drain_copies) so already-valid positions in the
+        block survive. Raises CacheExhausted when a COW needs a block
+        and the free list is empty."""
+        table = self._tables[seq_id]
+        bs = self.block_size
+        for bi in range(start // bs, (max(end, start + 1) - 1) // bs + 1):
+            old = table[bi]
+            if self._refs[old] <= 1:
+                continue
+            if not self._free:
+                raise CacheExhausted("no free block for copy-on-write")
+            new = self._pop_free()
+            self._refs[old] -= 1
+            self._refs[new] = 1
+            table[bi] = new
+            self._pending_copies.append((old, new))
+            self.cow_copies += 1
+
+    def drain_copies(self) -> List[Tuple[int, int]]:
+        """Queued COW block copies; the engine MUST replay them on the
+        device pools (src block -> dst block, every layer) before the
+        next prefill/decode call reads or writes the dst blocks."""
+        out, self._pending_copies = self._pending_copies, []
+        return out
+
+    def commit_prefill(self, seq_id: int, upto: int) -> None:
+        """Mark positions [0, upto) as present in the pool (a prefill
+        chunk just scattered them) and register every newly-full block
+        in the prefix index so later prompts can share it."""
+        self._committed[seq_id] = max(self._committed.get(seq_id, 0), upto)
+        self._register_full_blocks(seq_id)
+
+    def committed_len(self, seq_id: int) -> int:
+        return self._committed.get(seq_id, 0)
+
+    def _register_full_blocks(self, seq_id: int) -> None:
+        if not self.enable_prefix_cache:
+            return
+        bs = self.block_size
+        table = self._tables[seq_id]
+        toks = self._tokens[seq_id]
+        for bi in range(self._committed[seq_id] // bs):
+            block = table[bi]
+            if block in self._key_of:
+                continue                    # already indexed (maybe shared)
+            key = tuple(toks[:(bi + 1) * bs])
+            if key in self._index:
+                continue                    # duplicate content: first wins
+            self._index[key] = block
+            self._key_of[block] = key
 
     def append_token(self, seq_id: int) -> int:
         """Reserve the slot for this sequence's next token (allocating a
-        fresh block at a block boundary); returns the FLAT pool slot
-        (block_id * block_size + offset) the engine passes to the decode
-        step. Does NOT advance the length — call advance() after the
-        step actually writes."""
+        fresh block at a block boundary, COWing a shared tail block);
+        returns the FLAT pool slot (block_id * block_size + offset) the
+        engine passes to the decode step. Does NOT advance the length —
+        call advance() after the step actually writes."""
         pos = self._lens[seq_id]
         table = self._tables[seq_id]
         if pos == len(table) * self.block_size:     # block boundary
             if not self._free:
                 raise CacheExhausted("no free block for decode append")
-            table.append(self._free.popleft())
+            block = self._pop_free()
+            self._refs[block] = 1
+            table.append(block)
+        else:
+            self.ensure_writable(seq_id, pos, pos + 1)
         return table[pos // self.block_size] * self.block_size \
             + pos % self.block_size
 
-    def advance(self, seq_id: int) -> None:
+    def advance(self, seq_id: int, token: int) -> None:
+        """The decode step wrote `token`'s k/v at the reserved slot:
+        extend the sequence and index the tail block if it just
+        filled (generated continuations are shareable too)."""
+        self._tokens[seq_id].append(token)
         self._lens[seq_id] += 1
+        self._committed[seq_id] = self._lens[seq_id]
+        if self._lens[seq_id] % self.block_size == 0:
+            self._register_full_blocks(seq_id)
 
     def free_sequence(self, seq_id: int) -> int:
-        """Return a finished/evicted sequence's blocks; returns how many."""
+        """Drop this sequence's references; blocks whose refcount hits
+        zero return to the free list but KEEP their prefix-index entry
+        (cached-free): a later prompt with the same prefix revives them
+        instead of recomputing, and `_pop_free` lazily evicts the entry
+        only when the pool reuses the block for fresh content. Returns
+        how many blocks went back to the free list (shared ones live
+        on)."""
         blocks = self._tables.pop(seq_id, [])
         self._lens.pop(seq_id, None)
-        self._free.extend(blocks)
-        return len(blocks)
+        self._tokens.pop(seq_id, None)
+        self._committed.pop(seq_id, None)
+        freed = 0
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
+                freed += 1
+        return freed
 
     # -- views for the jitted step ---------------------------------------
     def seq_len(self, seq_id: int) -> int:
@@ -141,3 +335,35 @@ class PagedKVCache:
             raise ValueError(f"sequence {seq_id} spans {len(table)} blocks "
                              f"> max {max_blocks}")
         return table + [0] * (max_blocks - len(table))
+
+    # -- observability ----------------------------------------------------
+    def hit_rate(self) -> float:
+        """Fraction of all prompt tokens served from the prefix cache."""
+        return self.hit_tokens / max(1, self.prompt_tokens)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "hit_tokens": self.hit_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "hit_rate": round(self.hit_rate(), 4),
+            "cow_copies": self.cow_copies,
+            "shared_blocks": self.shared_blocks,
+            "used_blocks": self.used_blocks,
+            "occupancy": round(self.occupancy(), 4),
+        }
+
+    def reset_stats(self) -> None:
+        self.hit_tokens = self.prompt_tokens = self.cow_copies = 0
+
+    def assert_quiesced(self) -> None:
+        """Leak check: with no live sequences every refcount must be
+        gone and the free list full. Index entries may remain, but only
+        for cached-free blocks (their content stays reusable by
+        design); an indexed block NOT on the free list is a leak."""
+        assert not self._tables, f"live sequences: {list(self._tables)}"
+        assert not self._refs, f"leaked refcounts: {self._refs}"
+        assert len(self._free) == self.num_blocks - 1, (
+            f"free list {len(self._free)} != {self.num_blocks - 1}")
+        free = set(self._free)
+        leaked = [b for b in self._key_of if b not in free]
+        assert not leaked, f"indexed blocks not on the free list: {leaked}"

@@ -1,29 +1,36 @@
-"""Continuous batching scheduler (iteration-level scheduling, Orca-style).
+"""Continuous batching scheduler (iteration-level scheduling, Orca-style)
+with chunked prefill.
 
 Classic static batching admits a batch, decodes until EVERY member
 finishes, then admits the next — short requests wait on the longest
 one, and freed KV memory idles. Continuous batching reschedules every
 STEP: finished sequences leave the running set immediately, waiting
 requests are admitted the moment blocks free up, and each step the
-scheduler hands the engine either one prefill batch or one decode
-batch over the current running set.
+scheduler hands the engine either one prefill-chunk batch or one
+decode batch.
 
 Policy (simple and deterministic, ENGINE.md §scheduler):
 
-- Prefill-priority: if any waiting request fits (KV blocks available,
-  a running slot open, prompt under the per-step token budget), run a
-  prefill step admitting as many as fit, FIFO. New requests reach
-  their first token fast (TTFT), at the cost of slightly delaying
-  in-flight decodes for one step.
-- Otherwise run one decode step over all running sequences (one token
-  each).
-- Preemption by recompute: when decode needs a block and the pool is
-  empty, the LAST-admitted running request is evicted — its blocks are
-  freed and it rejoins the FRONT of the waiting queue with
-  prompt := prompt + generated, so its re-prefill reproduces the exact
-  KV state (cheaper than copy-out for short sequences, and the
-  deterministic choice keeps tests reproducible). FIFO order of the
-  others is preserved.
+- Admission is FIFO and block-bound only: a request admits when a
+  batch slot is open and its prompt's blocks fit (prefix-cache hits
+  shrink the bill). Admission allocates the WHOLE prompt's blocks and
+  records how many leading tokens the prefix cache already holds —
+  those are never prefilled.
+- CHUNKED PREFILL: the uncached tail of an admitted prompt is
+  prefilled in chunks of at most `max_prefill_tokens` tokens. When
+  both prefill work and decode-ready sequences exist, the scheduler
+  ALTERNATES chunk and decode steps, so one long prompt can neither
+  starve running decodes (inter-token latency stays bounded at one
+  chunk) nor be starved by them (TTFT stays bounded too). A request
+  whose final chunk ran becomes decode-ready (the engine samples its
+  first token from that chunk's logits).
+- Preemption by recompute: when a decode append or a COW copy needs a
+  block and the pool is empty, the LAST-admitted running request is
+  evicted — its blocks are dropped (refcounts) and it rejoins the
+  FRONT of the waiting queue with prompt := prompt + generated, so its
+  re-prefill reproduces the exact KV state (cheaper than copy-out for
+  short sequences, and the deterministic choice keeps tests
+  reproducible). FIFO order of the others is preserved.
 
 The scheduler owns no device state; it manipulates the PagedKVCache's
 host-side bookkeeping and Request objects. The engine turns its plans
@@ -35,7 +42,7 @@ from __future__ import annotations
 import itertools
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple, Union
 
 from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
 
@@ -60,6 +67,8 @@ class Request:
     state: str = WAITING
     preemptions: int = 0
     preempt_carry: int = 0            # tokens folded into prompt on preempt
+    prefill_pos: int = 0              # prompt tokens prefilled (or cached)
+    cached_tokens: int = 0            # prefix-cache hit at last admission
     enqueue_time: float = 0.0
     first_token_time: float = 0.0
     finish_time: float = 0.0
@@ -75,12 +84,30 @@ class Request:
         """Tokens generated across preemptions (prompt absorbs them)."""
         return len(self.generated) + self.preempt_carry
 
+    @property
+    def prefilling(self) -> bool:
+        # against the PROMPT, not tokens: generated tokens enter the
+        # cache via decode's append/advance, never via a chunk
+        return self.prefill_pos < len(self.prompt)
+
+
+@dataclass
+class PrefillChunk:
+    """One row of a prefill-chunk batch: prefill `req`'s prompt
+    positions [start, start + length)."""
+    req: Request
+    start: int
+    length: int
+
+
+Plan = Tuple[str, Union[List[Request], List[PrefillChunk]]]
+
 
 class Scheduler:
-    """Decides, per engine step, what work runs: a prefill batch or a
-    decode batch. Bounds: `max_batch_size` concurrent running
+    """Decides, per engine step, what work runs: a prefill-chunk batch
+    or a decode batch. Bounds: `max_batch_size` concurrent running
     sequences (the engine compiles its decode step for exactly this
-    batch), `max_prefill_tokens` padded prompt tokens per prefill step,
+    batch), `max_prefill_tokens` prompt tokens per prefill-chunk step,
     `max_seq_len` ceiling on prompt+generation."""
 
     def __init__(self, cache: PagedKVCache, max_batch_size: int = 8,
@@ -91,6 +118,7 @@ class Scheduler:
         self.max_seq_len = max_seq_len
         self.waiting: deque[Request] = deque()
         self.running: List[Request] = []
+        self._prefer_decode = False     # chunk/decode alternation state
         # engine hook, fired after a preemption moves a req back to waiting
         self.on_preempt: Optional[Callable[[Request], None]] = None
 
@@ -110,91 +138,148 @@ class Scheduler:
         return bool(self.waiting or self.running)
 
     # -- planning ---------------------------------------------------------
-    def next_batch(self) -> Optional[Tuple[str, List[Request]]]:
-        """Plan one step: ("prefill", admitted) | ("decode", running) |
-        None when idle. Prefill admission allocates cache blocks and
-        moves requests to RUNNING; decode planning guarantees every
-        running sequence has its next-token block reserved, preempting
-        if the pool runs dry."""
-        admitted = self._try_admit()
-        if admitted:
-            return ("prefill", admitted)
-        if self.running:
-            self._reserve_decode_blocks()
-            if self.running:
-                return ("decode", list(self.running))
-            # everything got preempted; retry admission with freed blocks
-            admitted = self._try_admit()
-            if admitted:
-                return ("prefill", admitted)
-        if self.waiting and not self.running:
-            # liveness check: with an idle engine and an empty pool, a
-            # head request that still can't admit NEVER will — fail loud
-            # instead of silently stranding it in the queue
-            req = self.waiting[0]
-            n = len(req.tokens)
-            if (n > self.max_prefill_tokens
-                    or self.cache.blocks_for(n) > self.cache.num_blocks - 1):
-                raise CacheExhausted(
-                    f"request {req.req_id} ({n} tokens incl. "
-                    f"{req.preempt_carry} preempt-folded) can never be "
-                    f"scheduled; raise max_prefill_tokens "
-                    f"({self.max_prefill_tokens}) or num_blocks "
-                    f"({self.cache.num_blocks})")
+    def next_batch(self) -> Optional[Plan]:
+        """Plan one step: ("prefill", [PrefillChunk]) | ("decode",
+        running) | None when idle. Admission allocates cache blocks
+        (prefix hits included) and moves requests to RUNNING; chunk
+        planning advances `prefill_pos` optimistically (the engine
+        always executes the plan it is handed); decode planning
+        guarantees every decode-ready sequence has its next-token block
+        reserved, preempting if the pool runs dry."""
+        self._try_admit()
+        prefilling = [r for r in self.running if r.prefilling]
+        decoding = [r for r in self.running if not r.prefilling]
+        if prefilling and decoding:
+            kind = "decode" if self._prefer_decode else "prefill"
+        elif prefilling:
+            kind = "prefill"
+        elif decoding:
+            kind = "decode"
+        else:
+            self._check_liveness()
+            return None
+
+        if kind == "prefill":
+            chunks = self._plan_chunks(prefilling)
+            if chunks:
+                self._prefer_decode = True
+                return ("prefill", chunks)
+            kind = "decode" if decoding else None   # chunk COW starved
+        if kind == "decode":
+            self._reserve_decode_blocks(decoding)
+            decoding = [r for r in decoding if r in self.running]
+            if decoding:
+                self._prefer_decode = False
+                return ("decode", decoding)
+            return self.next_batch()    # everything preempted; replan
         return None
 
     def _try_admit(self) -> List[Request]:
         admitted: List[Request] = []
-        budget = self.max_prefill_tokens
         while self.waiting:
             req = self.waiting[0]
-            n = len(req.tokens)
             if (len(self.running) + len(admitted) >= self.max_batch_size
-                    or n > budget
-                    or not self.cache.can_allocate(n)):
+                    or not self.cache.can_allocate(req.tokens)):
                 break       # FIFO: don't skip ahead of the head request
             self.waiting.popleft()
-            self.cache.alloc_sequence(req.req_id, n)
+            cached = self.cache.alloc_sequence(req.req_id, req.tokens)
+            req.prefill_pos = cached
+            req.cached_tokens = cached
             req.state = RUNNING
             admitted.append(req)
-            budget -= n
         self.running.extend(admitted)
         return admitted
 
-    def _reserve_decode_blocks(self) -> None:
-        """Ensure every running sequence can hold one more token,
-        evicting from the tail (last admitted) until allocation holds."""
-        i = 0
-        while i < len(self.running):
-            req = self.running[i]
+    def _plan_chunks(self, prefilling: List[Request]) -> List[PrefillChunk]:
+        """Token-budget-bounded chunk batch over prefilling requests in
+        admission order; one row per request, whole budget to the head
+        request first so earlier prompts reach their first token
+        sooner. COW (a chunk writing into a shared block) may need a
+        free block; the pool running dry preempts from the tail like
+        decode does."""
+        chunks: List[PrefillChunk] = []
+        budget = self.max_prefill_tokens
+        for req in list(prefilling):
+            if budget <= 0 or len(chunks) >= self.max_batch_size:
+                break
+            if req not in self.running:     # preempted by an earlier COW
+                continue
+            take = min(len(req.prompt) - req.prefill_pos, budget)
+            start = req.prefill_pos
+            self._ensure_writable_or_preempt(req, start, start + take)
+            req.prefill_pos += take
+            budget -= take
+            chunks.append(PrefillChunk(req, start, take))
+        return chunks
+
+    def _ensure_writable_or_preempt(self, req: Request, start: int,
+                                    end: int) -> None:
+        """COW the chunk's target blocks, evicting tail requests (never
+        `req` itself) while the pool is dry."""
+        while True:
             try:
-                self.cache.append_token(req.req_id)
-                i += 1
+                self.cache.ensure_writable(req.req_id, start, end)
+                return
             except CacheExhausted:
-                if len(self.running) == 1:
-                    raise CacheExhausted(
-                        "single sequence exceeds total KV pool; "
-                        "increase num_blocks or lower max_seq_len")
-                victim = self.running[-1]
-                if victim is req:
-                    victim = self.running[-2]
+                victim = self._pick_victim(req)
+                if victim is None:
+                    raise
                 self.preempt(victim)
-                # re-check same index (list may have shifted under us)
-                i = self.running.index(req) if req in self.running else i
+
+    def _reserve_decode_blocks(self, decoding: List[Request]) -> None:
+        """Ensure every decode-ready sequence can hold one more token,
+        evicting from the tail (last admitted) until allocation holds."""
+        for req in decoding:
+            while req in self.running:
+                try:
+                    self.cache.append_token(req.req_id)
+                    break
+                except CacheExhausted:
+                    victim = self._pick_victim(req)
+                    if victim is None:
+                        raise CacheExhausted(
+                            "single sequence exceeds total KV pool; "
+                            "increase num_blocks or lower max_seq_len")
+                    self.preempt(victim)
+
+    def _pick_victim(self, keep: Request) -> Optional[Request]:
+        """Last-admitted running request other than `keep`; None when
+        nothing else is left to evict."""
+        for r in reversed(self.running):
+            if r is not keep:
+                return r
+        return None
 
     def preempt(self, req: Request) -> None:
-        """Evict by recompute: free blocks, fold generated tokens into the
-        prompt, and requeue at the FRONT so it re-prefills first."""
+        """Evict by recompute: drop block refs, fold generated tokens
+        into the prompt, and requeue at the FRONT so it re-prefills
+        first."""
         self.cache.free_sequence(req.req_id)
         self.running.remove(req)
         req.preempt_carry += len(req.generated)
         req.prompt = req.prompt + req.generated
         req.generated = []
         req.preemptions += 1
+        req.prefill_pos = 0
         req.state = WAITING
         self.waiting.appendleft(req)
         if self.on_preempt is not None:
             self.on_preempt(req)
+
+    def _check_liveness(self) -> None:
+        """With an idle engine and an empty pool, a head request that
+        still can't admit NEVER will — fail loud instead of silently
+        stranding it in the queue. (Chunked prefill removed the
+        prefill-budget ceiling: any prompt that fits the pool admits.)"""
+        if not self.waiting or self.running:
+            return
+        req = self.waiting[0]
+        n = len(req.tokens)
+        if self.cache.blocks_for(n) > self.cache.num_blocks - 1:
+            raise CacheExhausted(
+                f"request {req.req_id} ({n} tokens incl. "
+                f"{req.preempt_carry} preempt-folded) can never be "
+                f"scheduled; raise num_blocks ({self.cache.num_blocks})")
 
     # -- completion -------------------------------------------------------
     def finish(self, req: Request, reason: str) -> None:

@@ -68,6 +68,47 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens,
                                scale=scale)[:, 0].astype(q.dtype)
 
 
+def paged_prefill_attention(q, k_pool, v_pool, block_tables, context_lens,
+                            q_positions, scale: Optional[float] = None):
+    """Chunked-prefill attention: a CHUNK of queries per sequence
+    attends through the block table — over the prefix KV already in the
+    pool AND the chunk's own KV (the caller scatters the chunk's k/v
+    into the pool first), causally.
+
+    q: [B, C, H, D] chunk queries; q_positions: [B, C] int32 absolute
+    position of each query (start offset + within-chunk index — rows of
+    a batch may start at different depths, and pad rows sit at
+    position 0); pools [NB, BS, Hkv, D]; block_tables [B, MB];
+    context_lens [B] int32 = each row's chunk-end position (or 1 for
+    pad rows). Returns [B, C, H, D].
+
+    A gathered slot's logical position IS its index in block-table
+    order, so causality is `kv_pos <= q_pos` — which also masks the
+    scratch-block garbage gathered for padded table entries (their
+    kv_pos exceeds every real query position). Masked scores sit at
+    NEG_INF and underflow to exact 0 after the softmax's max-shift, so
+    widening the gather never perturbs the attended sum — the property
+    the engine's exact batching-invariance tests lean on.
+
+    XLA-only for now: chunk prefill is compute-bound (unlike decode,
+    whose gather the Pallas kernel exists to keep HBM-shaped), and the
+    dense gather is the same oracle path `paged_attention_reference`
+    uses. A Pallas ragged-prefill kernel (PAPERS.md "Ragged Paged
+    Attention") is the TPU-rig follow-up tracked in ROADMAP.md.
+    """
+    b, c, h, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    k = k_pool[block_tables].reshape(b, mb * bs, hkv, d)
+    v = v_pool[block_tables].reshape(b, mb * bs, hkv, d)
+    kv_pos = jnp.arange(mb * bs, dtype=jnp.int32)
+    mask = ((kv_pos[None, None, :] <= q_positions[:, :, None])
+            & (kv_pos[None, None, :] < context_lens[:, None, None]))
+    return reference_attention(q.astype(k.dtype), k, v,
+                               mask=mask[:, None], scale=scale
+                               ).astype(q.dtype)
+
+
 def _scratch(shape):
     if _VMEM is None:  # pragma: no cover
         raise RuntimeError(

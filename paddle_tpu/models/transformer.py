@@ -213,6 +213,44 @@ class MultiHeadAttention(Module):
         out = self.out_proj(cx, out.reshape(x.shape[0], 1, self.model_dim))
         return out, (k_pool, v_pool)
 
+    def prefill_chunk_paged(self, cx: Context, x, q_positions, k_pool,
+                            v_pool, block_tables, context_lens, slots):
+        """CHUNKED prefill through a paged KV cache (the serving path's
+        suffix-only prefill). x: [B, C, D] — a window of each prompt,
+        not necessarily starting at position 0 (prefix-cache hits skip
+        the cached head; long prompts arrive one budget-bounded chunk
+        per step); q_positions: [B, C] absolute positions; slots:
+        [B*C] flat pool slots receiving this chunk's k/v. The chunk
+        k/v is scattered into the pool FIRST, then every chunk query
+        attends causally through the block table — over the cached
+        prefix and the chunk itself in one go. Returns
+        (out [B, C, D], (new_k_pool, new_v_pool))."""
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        if self.fused_qkv:
+            b, t = x.shape[:2]
+            p = self.qkv(cx, x).reshape(       # head-major: [H, 3, hd]
+                b, t, self.num_heads, 3, self.head_dim)
+            qh, kh, vh = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+        else:
+            qh = self._split(self.q_proj(cx, x))
+            kh = self._split_kv(self.k_proj(cx, x))
+            vh = self._split_kv(self.v_proj(cx, x))
+        nb, bs = k_pool.shape[:2]
+        flat = (nb * bs,) + k_pool.shape[2:]
+        k_pool = k_pool.reshape(flat).at[slots].set(
+            kh.reshape((-1,) + kh.shape[2:]).astype(k_pool.dtype)
+        ).reshape(k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[slots].set(
+            vh.reshape((-1,) + vh.shape[2:]).astype(v_pool.dtype)
+        ).reshape(v_pool.shape)
+        from paddle_tpu.kernels import paged_attention as paged
+        out = paged.paged_prefill_attention(qh, k_pool, v_pool,
+                                            block_tables, context_lens,
+                                            q_positions)   # [B, C, H, hd]
+        b, c = x.shape[:2]
+        out = self.out_proj(cx, out.reshape(b, c, self.model_dim))
+        return out, (k_pool, v_pool)
+
 
 class FeedForward(Module):
     def __init__(self, model_dim: int, hidden_dim: int, dropout: float = 0.1,
@@ -440,6 +478,16 @@ class CausalBlock(Module):
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x, pools
 
+    def prefill_chunk_paged(self, cx: Context, x, q_positions, k_pool,
+                            v_pool, block_tables, context_lens, slots):
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        h, pools = self.attn.prefill_chunk_paged(
+            cx, self.ln1(cx, x), q_positions, k_pool, v_pool,
+            block_tables, context_lens, slots)
+        x = x + self.drop(cx, h)
+        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        return x, pools
+
 
 class CausalLM(Module):
     """Decoder-only autoregressive LM (GPT-style).
@@ -571,6 +619,40 @@ class CausalLM(Module):
         last_h = jnp.take_along_axis(
             hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)
         return self._head(cx, last_h)[:, 0], kvs
+
+    def prefill_chunk_paged(self, cx: Context, tokens, start_pos, pools,
+                            block_tables, context_lens, slots, last_idx):
+        """Chunked/suffix-only prefill for paged serving: tokens [B, C]
+        is ONE WINDOW of each prompt (right-padded; pad positions
+        scatter to scratch slot 0), start_pos [B] int32 the absolute
+        position of each row's first chunk token — a prefix-cache hit
+        starts the window mid-prompt, and a long prompt arrives one
+        budget-bounded chunk per step. Attention runs causally through
+        the block pool (cached prefix + this chunk), so positional
+        encodings are offset by start_pos. Returns (logits [B, V] at
+        each row's `last_idx` within-chunk position, new pools) — only
+        a prompt's FINAL chunk's logits are sampled (the first
+        generated token); earlier chunks exist to populate KV.
+        Subsumes whole-prompt prefill: start_pos=0 with the chunk
+        budget covering the prompt is the monolithic case."""
+        b, c = tokens.shape
+        x = self.embed(cx, tokens) * math.sqrt(self.model_dim)
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)
+        pos = start_pos.astype(jnp.int32)[:, None] \
+            + jnp.arange(c, dtype=jnp.int32)[None, :]          # [B, C]
+        pos_safe = jnp.clip(pos, 0, self.max_len - 1)
+        x = x + pe[pos_safe].astype(x.dtype)
+        new_pools = []
+        for blk, (k_pool, v_pool) in zip(self.blocks, pools):
+            x, np_ = blk.prefill_chunk_paged(cx, x, pos, k_pool, v_pool,
+                                             block_tables, context_lens,
+                                             slots)
+            new_pools.append(np_)
+        hidden = self.ln_f(cx, x)
+        idx = last_idx.astype(jnp.int32)[:, None, None]
+        last_h = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)
+        return self._head(cx, last_h)[:, 0], new_pools
 
     def decode_step_paged(self, cx: Context, tokens, positions, pools,
                           block_tables, context_lens, slots):

@@ -53,8 +53,8 @@ class TestPagedKVCache:
         c = PagedKVCache(num_layers=1, num_blocks=9, block_size=4,
                          num_kv_heads=2, head_dim=8)
         assert c.free_blocks == 8          # block 0 reserved
-        c.alloc_sequence(1, 5)             # 2 blocks
-        c.alloc_sequence(2, 4)             # exact boundary: 1 block
+        c.alloc_sequence(1, [1] * 5)       # 2 blocks
+        c.alloc_sequence(2, [2] * 4)       # exact boundary: 1 block
         assert c.used_blocks == 3
         assert c.blocks_for(5) == 2 and c.blocks_for(4) == 1
         assert c.free_sequence(1) == 2
@@ -64,7 +64,7 @@ class TestPagedKVCache:
     def test_append_crosses_block_boundary(self):
         c = PagedKVCache(num_layers=1, num_blocks=4, block_size=4,
                          num_kv_heads=2, head_dim=8)
-        c.alloc_sequence(7, 4)
+        c.alloc_sequence(7, [1, 2, 3, 4])
         assert c.used_blocks == 1
         slot = c.append_token(7)           # position 4 -> new block
         assert c.used_blocks == 2
@@ -72,22 +72,22 @@ class TestPagedKVCache:
         assert slot % 4 == 0               # first slot of the new block
         # append before advance is idempotent (same reservation)
         assert c.append_token(7) == slot
-        c.advance(7)
+        c.advance(7, 9)
         assert c.seq_len(7) == 5
 
     def test_exhaustion_raises_without_partial_alloc(self):
         c = PagedKVCache(num_layers=1, num_blocks=3, block_size=4,
                          num_kv_heads=2, head_dim=8)
-        c.alloc_sequence(1, 4)
+        c.alloc_sequence(1, [1] * 4)
         with pytest.raises(CacheExhausted):
-            c.alloc_sequence(2, 12)        # needs 3, only 1 free
+            c.alloc_sequence(2, [2] * 12)  # needs 3, only 1 free
         assert c.free_blocks == 1          # nothing leaked
         assert c.can_allocate(4) and not c.can_allocate(5)
 
     def test_block_zero_never_allocated(self):
         c = PagedKVCache(num_layers=1, num_blocks=5, block_size=2,
                          num_kv_heads=1, head_dim=4)
-        c.alloc_sequence(1, 8)             # all 4 allocatable blocks
+        c.alloc_sequence(1, list(range(8)))   # all 4 allocatable blocks
         assert 0 not in c.block_table(1)
         assert c.padded_table(1, 6)[-2:] == [0, 0]   # padding IS block 0
 
@@ -101,20 +101,37 @@ class TestScheduler:
         s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
         for p in ([1, 2, 3], [4, 5], [6]):
             s.add(Request(prompt=list(p)))
-        kind, reqs = s.next_batch()
+        kind, chunks = s.next_batch()
         assert kind == "prefill"
-        assert [len(r.prompt) for r in reqs] == [3, 2]   # batch cap hit
+        assert [ch.length for ch in chunks] == [3, 2]    # batch cap hit
+        assert [ch.start for ch in chunks] == [0, 0]
         assert s.queue_depth == 1
         kind, reqs2 = s.next_batch()
         assert kind == "decode" and len(reqs2) == 2      # admission full
 
+    def test_long_prompt_prefills_in_chunks(self):
+        """A prompt over the per-step budget admits anyway and is cut
+        into budget-bounded chunks at successive offsets."""
+        c = PagedKVCache(num_layers=1, num_blocks=64, block_size=4,
+                         num_kv_heads=2, head_dim=8)
+        s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
+        s.add(Request(prompt=list(range(20))))
+        seen = []
+        for _ in range(3):
+            kind, chunks = s.next_batch()
+            assert kind == "prefill" and len(chunks) == 1
+            seen.append((chunks[0].start, chunks[0].length))
+        assert seen == [(0, 8), (8, 8), (16, 4)]
+        assert not s.running[0].prefilling
+
     def test_unschedulable_head_fails_loud(self):
-        """A head request that can NEVER admit (over the prefill budget
-        or bigger than the whole pool) must raise, not strand silently."""
+        """A head request that can NEVER fit the pool (even alone) must
+        raise, not strand silently. (Over the prefill budget is no
+        longer fatal — chunked prefill covers it.)"""
         c = PagedKVCache(num_layers=1, num_blocks=4, block_size=4,
                          num_kv_heads=2, head_dim=8)
         s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
-        s.add(Request(prompt=list(range(16))))   # > budget and > pool
+        s.add(Request(prompt=list(range(16))))   # 4 blocks > 3 usable
         with pytest.raises(CacheExhausted, match="never"):
             s.next_batch()
 
@@ -225,12 +242,18 @@ def test_serve_events_emitted(model_and_vars, capsys):
 
 def test_oversize_prompt_rejected_at_intake(model_and_vars):
     model, variables = model_and_vars
-    eng = _engine(model, variables, max_prefill_tokens=8)
-    with pytest.raises(ValueError, match="max_prefill_tokens"):
-        eng.add_request(list(range(10)))
-    roomy = _engine(model, variables)        # default prefill budget
+    roomy = _engine(model, variables)
     with pytest.raises(ValueError, match="no room"):
         roomy.add_request([1] * 64)          # max_seq_len is 64
+    tiny = _engine(model, variables, num_blocks=4)
+    with pytest.raises(ValueError, match="num_blocks"):
+        tiny.add_request(list(range(12)))    # 13 slots -> 4 blocks > 3
+    # over the per-STEP chunk budget is no longer a rejection: long
+    # prompts admit and prefill across chunked steps
+    chunky = _engine(model, variables, max_prefill_tokens=8)
+    req = chunky.add_request(list(range(10)), max_new_tokens=2)
+    chunky.run()
+    assert req.num_generated == 2
 
 
 def test_eos_stops_early(model_and_vars):

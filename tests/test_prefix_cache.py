@@ -1,0 +1,256 @@
+"""Prefix-shared KV cache + chunked prefill tests (engine/).
+
+The tentpole guarantees under test:
+
+- SHARING IS INVISIBLE: a request whose prompt prefix rides shared
+  (refcounted) blocks produces EXACTLY the tokens it would produce
+  with sharing disabled — copy-on-write isolates every divergence, and
+  reused KV is bit-identical to recomputed KV (same tokens, same
+  positions, same compiled step).
+- CHUNKING IS INVISIBLE: a prompt prefilled in budget-bounded chunks
+  interleaved with decode steps produces exactly the monolithic
+  result, while each prefill step stays within the token budget.
+- NOTHING LEAKS: when the engine drains, every refcount is released
+  and the free list is whole (`assert_quiesced`).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.engine import CacheExhausted, PagedKVCache, ServeEngine
+from paddle_tpu.models.transformer import CausalLM
+
+pytestmark = pytest.mark.serve
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = CausalLM(vocab=VOCAB, model_dim=16, num_heads=4, num_layers=2,
+                     ffn_dim=32, dropout=0.0, max_len=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    return ServeEngine(model, variables, **kw)
+
+
+def _cache(**kw):
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("head_dim", 8)
+    return PagedKVCache(**kw)
+
+
+# -- allocator-level sharing ----------------------------------------------
+
+class TestPrefixSharing:
+    def test_full_hit_refcounts_and_cow(self):
+        c = _cache()
+        toks = list(range(8))                    # 2 full blocks
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)                   # KV "in the pool" now
+        cached = c.alloc_sequence(2, toks)
+        assert cached == 7                       # full hit capped at n-1
+        assert c.shared_blocks == 2
+        assert [c.ref_count(b) for b in c.block_table(1)] == [2, 2]
+        assert c.block_table(2) == c.block_table(1)
+        # the capped last token writes mid shared block -> COW
+        c.ensure_writable(2, 7, 8)
+        assert c.cow_copies == 1 and c.shared_blocks == 1
+        assert c.block_table(2)[0] == c.block_table(1)[0]
+        assert c.block_table(2)[1] != c.block_table(1)[1]
+        copies = c.drain_copies()
+        assert copies == [(c.block_table(1)[1], c.block_table(2)[1])]
+        c.free_sequence(1)
+        c.free_sequence(2)
+        c.assert_quiesced()
+
+    def test_partial_hit_and_divergence(self):
+        c = _cache()
+        a = list(range(8))
+        c.alloc_sequence(1, a)
+        c.commit_prefill(1, 8)
+        b = a[:4] + [50, 51, 52, 53]             # shares one full block
+        assert c.alloc_sequence(2, b) == 4
+        assert c.ref_count(c.block_table(2)[0]) == 2
+        assert c.ref_count(c.block_table(2)[1]) == 1   # fresh, private
+        assert c.block_table(2)[1] != c.block_table(1)[1]
+
+    def test_uncommitted_blocks_never_hit(self):
+        """A block whose scatter hasn't executed must not be shared."""
+        c = _cache()
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)                # no commit_prefill
+        assert c.alloc_sequence(2, toks) == 0
+
+    def test_disabled_prefix_cache_shares_nothing(self):
+        c = _cache(enable_prefix_cache=False)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        assert c.alloc_sequence(2, toks) == 0
+        assert c.shared_blocks == 0
+
+    def test_cached_free_blocks_revive_after_free(self):
+        """Freeing the last reference keeps the KV reusable: the block
+        sits on the free list still indexed, and the same prefix
+        revives it instead of recomputing."""
+        c = _cache()
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.free_sequence(1)
+        c.assert_quiesced()                      # free, yet still cached
+        assert c.alloc_sequence(2, toks) == 7
+        assert c.free_blocks == _cache().free_blocks - 2
+
+    def test_cached_free_blocks_evict_on_reuse(self):
+        """Handing a cached-free block out for fresh content drops its
+        stale index entry — later prompts must not hit recycled KV."""
+        c = _cache(num_blocks=5)                 # 4 usable blocks
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.free_sequence(1)
+        c.alloc_sequence(2, [40] * 16)           # consumes all 4 blocks
+        c.free_sequence(2)
+        assert c.alloc_sequence(3, toks) == 0    # cached content is gone
+
+
+# -- engine-level: sharing is invisible -----------------------------------
+
+SYSTEM = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]          # 3 full blocks
+TAILS = [[21, 22, 23, 24], [31, 32, 33, 34], [41, 42, 43, 44]]
+PROMPTS = [SYSTEM + t for t in TAILS]
+
+
+def test_shared_prefix_identical_to_unshared(model_and_vars):
+    model, variables = model_and_vars
+    base = []
+    for p in PROMPTS:
+        eng = _engine(model, variables, enable_prefix_cache=False)
+        base.append(eng.generate([p], max_new_tokens=8)[0])
+        assert eng.cache.hit_tokens == 0
+    shared = _engine(model, variables)
+    got = [shared.generate([p], max_new_tokens=8)[0] for p in PROMPTS]
+    assert got == base                     # sharing never changes tokens
+    assert shared.cache.hit_tokens >= 2 * len(SYSTEM)   # 2nd+3rd hit
+    assert shared.prefill_tokens_computed < sum(map(len, PROMPTS))
+    shared.cache.assert_quiesced()
+
+
+def test_duplicate_prompt_full_hit_triggers_cow(model_and_vars):
+    """An identical prompt arriving while the original still runs hits
+    every full block LIVE-shared; the capped last token recomputes into
+    a shared block, so COW must fire — and the answer must not
+    change. (Arriving after the original finishes, the same hit rides
+    cached-free blocks at refcount 1 and writes in place: no COW.)"""
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    p = SYSTEM + TAILS[0]                        # 16 tokens, 4 full blocks
+    solo = _engine(model, variables).generate([p], max_new_tokens=8)[0]
+    r1 = eng.add_request(p, max_new_tokens=8)
+    for _ in range(3):                           # prefill + some decode
+        eng.step()
+    r2 = eng.add_request(p, max_new_tokens=8)    # r1 still live
+    eng.run()
+    assert eng._generated_of(r1) == solo
+    assert eng._generated_of(r2) == solo
+    assert r2.cached_tokens == 15                # full hit capped at n-1
+    assert eng.cache.cow_copies >= 1
+    eng.cache.assert_quiesced()
+
+
+def test_concurrent_sharing_batch(model_and_vars):
+    """Prompts submitted together: later admissions in the same drain
+    still share whatever earlier ones committed first."""
+    model, variables = model_and_vars
+    base = _engine(model, variables, enable_prefix_cache=False).generate(
+        PROMPTS, max_new_tokens=8)
+    eng = _engine(model, variables, max_batch_size=2)   # staggered admits
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert got == base
+    assert eng.cache.hit_tokens > 0
+    eng.cache.assert_quiesced()
+
+
+def test_preemption_with_sharing_keeps_siblings_intact(model_and_vars):
+    """A tight pool preempts sequences that SHARE blocks with live
+    siblings; refcounts must keep the survivors' KV intact and the
+    rerun must reproduce the roomy run exactly."""
+    model, variables = model_and_vars
+    prompts = [[7, 3, 7, 3] + t for t in TAILS]         # shared head block
+    roomy = _engine(model, variables, max_batch_size=3)
+    want = roomy.generate(prompts, max_new_tokens=12)
+    tight = _engine(model, variables, max_batch_size=3, num_blocks=9)
+    got = tight.generate(prompts, max_new_tokens=12)
+    assert sum(r.preemptions for r in tight.finished.values()) > 0
+    assert got == want
+    tight.cache.assert_quiesced()
+
+
+# -- engine-level: chunking is invisible ----------------------------------
+
+LONG = list(range(1, 25))                        # 24-token prompt
+
+
+def test_chunked_prefill_identical_to_monolithic(model_and_vars):
+    model, variables = model_and_vars
+    mono = _engine(model, variables).generate([LONG], max_new_tokens=8)
+    for budget in (4, 7, 16):
+        eng = _engine(model, variables, max_prefill_tokens=budget)
+        assert eng.generate([LONG], max_new_tokens=8) == mono
+        assert eng.max_chunk_tokens <= budget
+        eng.cache.assert_quiesced()
+
+
+def test_chunked_prefill_interleaves_decode(model_and_vars, capsys):
+    """While a long prompt prefills chunk by chunk, an already-running
+    request keeps decoding — and every prefill step stays within the
+    token budget (bounded inter-token latency)."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, max_prefill_tokens=4)
+    eng.add_request([5, 9, 2], max_new_tokens=10)
+    eng.add_request(LONG, max_new_tokens=4)
+    eng.run()
+    events = [json.loads(line) for line in
+              capsys.readouterr().out.strip().splitlines()
+              if line.startswith('{"evt"')]
+    prefills = [i for i, e in enumerate(events)
+                if e["evt"] == "serve_prefill"]
+    decodes = [i for i, e in enumerate(events) if e["evt"] == "serve_decode"]
+    assert len(prefills) >= 4                    # long prompt chunked
+    assert all(events[i]["tokens"] <= 4 for i in prefills)
+    # decode steps run BETWEEN chunk steps, not after them all
+    assert any(prefills[0] < d < prefills[-1] for d in decodes)
+
+
+def test_serve_events_carry_cache_stats(model_and_vars, capsys):
+    model, variables = model_and_vars
+    eng = _engine(model, variables)
+    eng.generate([SYSTEM + TAILS[0]], max_new_tokens=4)
+    eng.generate([SYSTEM + TAILS[1]], max_new_tokens=4)
+    events = [json.loads(line) for line in
+              capsys.readouterr().out.strip().splitlines()
+              if line.startswith('{"evt"')]
+    pre = [e for e in events if e["evt"] == "serve_prefill"]
+    assert pre and all(
+        {"tokens", "cached", "cow", "shared_blocks", "hit_rate",
+         "occupancy"} <= set(e) for e in pre)
+    assert pre[-1]["hit_rate"] > 0               # second prompt hit
+    stats = eng.stats()
+    assert stats["hit_tokens"] == len(SYSTEM)
+    assert 0 < stats["peak_occupancy"] <= 1
+    assert stats["prefill_tokens_computed"] < 2 * len(SYSTEM + TAILS[0])

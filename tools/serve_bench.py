@@ -1,19 +1,30 @@
-"""Serving microbench: continuous batching vs sequential decode.
+"""Serving microbench: batching, prefix sharing, chunked prefill.
 
-The acceptance property of the engine subsystem (ENGINE.md): on the
-SAME model and request set, the continuous-batching ServeEngine must
-beat one-request-at-a-time decode on throughput — batching amortizes
-each weight pass over every running sequence, so even a CPU microbench
-shows the gap.
+Three scenarios, each an acceptance property of the engine subsystem
+(ENGINE.md), each verified on the SAME model with EXACT token identity
+(greedy decode — the engine's batching/sharing/chunking invariance
+makes identity, not closeness, the bar):
 
-One JSON line per mode on stdout (chaos_sweep.py verdict style):
+- batch:   continuous batching must beat one-request-at-a-time decode
+           on throughput (weight passes amortized over the batch).
+- prefix:  N requests sharing a long system prompt must beat the same
+           requests with prefix caching disabled on BOTH mean TTFT and
+           prefill tokens computed, with a nonzero cache hit rate —
+           shared full blocks are reused, only tails are prefilled.
+- chunked: prefilling a long prompt in budget-bounded chunks must
+           bound the worst-case step latency below the monolithic
+           prefill's (inter-token latency of concurrent decodes stays
+           bounded), at identical outputs.
 
-    {"cell": "batched", "tok_s": 123.4, "wall_s": 1.2, ...}
-    {"cell": "TOTAL", "ok": true, "speedup": 3.1}
+One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
+(flushed — a harness timeout still sees every completed cell):
 
-Exit code: 0 iff batched throughput > sequential throughput.
+    {"cell": "prefix_shared", "mean_ttft_ms": 3.1, ...}
+    {"cell": "TOTAL", "ok": true, ...}
 
-Run: python tools/serve_bench.py [--requests 8] [--new-tokens 24]
+Exit code: 0 iff every scenario's verdict holds.
+
+Run: python tools/serve_bench.py [--scenario all|batch|prefix|chunked]
 """
 
 import argparse
@@ -26,7 +37,11 @@ import _bootstrap  # noqa: F401  (repo path + cpu override)
 import numpy as np
 
 
-def build(args):
+def emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def build_model(args):
     import jax
     import jax.numpy as jnp
 
@@ -38,45 +53,188 @@ def build(args):
                      max_len=args.max_len)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def make_engine(model, variables, args, **kw):
+    from paddle_tpu.engine import ServeEngine
+
+    kw.setdefault("max_batch_size", args.batch)
+    kw.setdefault("block_size", args.block_size)
+    kw.setdefault("num_blocks", args.num_blocks)
+    return ServeEngine(model, variables, **kw)
+
+
+def serve_turns(eng, prompts, new_tokens):
+    """Serve prompts one turn at a time (each drains before the next
+    arrives — the shared-system-prompt conversation pattern). TTFT is
+    then pure prefill latency, undiluted by queue wait or decode, so
+    the prefix cache's effect on it is directly visible. Returns
+    (outs, mean TTFT ms, wall s)."""
+    outs, ttft = [], []
+    t0 = time.perf_counter()
+    for p in prompts:
+        r = eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        outs.append(eng._generated_of(r))
+        ttft.append((r.first_token_time - r.enqueue_time) * 1e3)
+    wall = time.perf_counter() - t0
+    return outs, float(np.mean(ttft)), wall
+
+
+# -- scenario: continuous batching vs sequential ---------------------------
+
+def scenario_batch(model, variables, args):
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, args.vocab,
                             rng.integers(4, args.prompt_len + 1)).tolist()
                for _ in range(args.requests)]
-    return model, variables, prompts
+    cells = {}
+    for batched in (False, True):
+        eng = make_engine(model, variables, args,
+                          max_batch_size=args.batch if batched else 1)
+        # warmup on THIS engine: compile prefill bucket + decode step
+        # outside the timed window so both modes measure steady state
+        eng.generate([prompts[0]], max_new_tokens=2)
+        t0 = time.perf_counter()
+        if batched:
+            outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
+        else:
+            # static serving: each request fully drains before the next
+            outs = [eng.generate([p], max_new_tokens=args.new_tokens)[0]
+                    for p in prompts]
+        wall = time.perf_counter() - t0
+        toks = sum(len(o) for o in outs)
+        name = "batched" if batched else "sequential"
+        cells[name] = {"cell": name, "requests": len(prompts),
+                       "generated_tokens": toks, "wall_s": round(wall, 3),
+                       "tok_s": round(toks / wall, 2)}
+        cells[name + "_outs"] = outs
+        emit(cells[name])
+    identical = cells["batched_outs"] == cells["sequential_outs"]
+    faster = cells["batched"]["tok_s"] > cells["sequential"]["tok_s"]
+    ok = bool(faster and identical)
+    emit({"cell": "batch_verdict", "ok": ok,
+          "speedup": round(cells["batched"]["tok_s"]
+                           / max(cells["sequential"]["tok_s"], 1e-9), 2),
+          "tokens_identical": bool(identical)})
+    return ok
 
 
-def run_mode(model, variables, prompts, args, batched: bool):
-    """Time a full drain; TTFT/tok-s per request ride the serve_done
-    events, this returns the aggregate."""
-    from paddle_tpu.engine import ServeEngine
+# -- scenario: shared system prompt, prefix cache on vs off ----------------
 
-    eng = ServeEngine(model, variables,
-                      max_batch_size=args.batch if batched else 1,
-                      block_size=args.block_size,
-                      num_blocks=args.num_blocks)
-    # warmup on THIS engine: compile the prefill bucket + decode step
-    # outside the timed window so both modes measure steady-state serving
-    eng.generate([prompts[0]], max_new_tokens=2)
+def scenario_prefix(model, variables, args):
+    rng = np.random.default_rng(1)
+    system = rng.integers(0, args.vocab - 1, args.system_len).tolist()
+    prompts = [system + rng.integers(0, args.vocab - 1,
+                                     args.tail_len).tolist()
+               for _ in range(args.requests)]
+    # warmup prompts reuse no bench content: token id vocab-1 only
+    warm_long = [args.vocab - 1] * len(prompts[0])
 
-    t0 = time.perf_counter()
-    if batched:
-        outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
-    else:
-        # static serving: one request fully drained before the next starts
-        outs = [eng.generate([p], max_new_tokens=args.new_tokens)[0]
-                for p in prompts]
-    wall = time.perf_counter() - t0
-    toks = sum(len(o) for o in outs)
-    return {"cell": "batched" if batched else "sequential",
-            "requests": len(prompts), "generated_tokens": toks,
-            "wall_s": round(wall, 3), "tok_s": round(toks / wall, 2)}, outs
+    results = {}
+    for enabled in (False, True):
+        eng = make_engine(model, variables, args,
+                          enable_prefix_cache=enabled)
+        # compile the full-prompt bucket, the suffix bucket (via a
+        # same-prefix warmup hit), and the decode step, untimed
+        eng.generate([warm_long], max_new_tokens=2)
+        eng.generate([warm_long[:-1] + [args.vocab - 2]],
+                     max_new_tokens=2)
+        eng.reset_stats()
+        outs, mean_ttft, wall = serve_turns(eng, prompts, args.new_tokens)
+        stats = eng.stats()
+        name = "prefix_shared" if enabled else "prefix_baseline"
+        results[name] = {
+            "cell": name, "requests": len(prompts),
+            "prompt_len": len(prompts[0]), "wall_s": round(wall, 3),
+            "mean_ttft_ms": round(mean_ttft, 3),
+            "prefill_tokens_computed": stats["prefill_tokens_computed"],
+            "hit_rate": stats["hit_rate"],
+            "cow_copies": stats["cow_copies"],
+            "peak_occupancy": stats["peak_occupancy"]}
+        results[name + "_outs"] = outs
+        emit(results[name])
+        eng.cache.assert_quiesced()
+    shared, base = results["prefix_shared"], results["prefix_baseline"]
+    identical = results["prefix_shared_outs"] == results[
+        "prefix_baseline_outs"]
+    ok = bool(identical
+              and shared["prefill_tokens_computed"]
+              < base["prefill_tokens_computed"]
+              and shared["mean_ttft_ms"] < base["mean_ttft_ms"]
+              and shared["hit_rate"] > 0)
+    emit({"cell": "prefix_verdict", "ok": ok,
+          "tokens_identical": bool(identical),
+          "prefill_tokens_saved": base["prefill_tokens_computed"]
+          - shared["prefill_tokens_computed"],
+          "ttft_speedup": round(base["mean_ttft_ms"]
+                                / max(shared["mean_ttft_ms"], 1e-9), 2),
+          "hit_rate": shared["hit_rate"]})
+    return ok
+
+
+# -- scenario: chunked vs monolithic prefill -------------------------------
+
+def _run_chunked_cell(model, variables, args, budget):
+    """One short decoding request + one long prompt arriving mid-serve;
+    per-step wall times timed individually. Returns (cell, outs)."""
+    eng = make_engine(model, variables, args, max_prefill_tokens=budget)
+    warm = [args.vocab - 1] * args.system_len
+    eng.generate([warm], max_new_tokens=2)          # compile untimed
+    eng.reset_stats()
+
+    rng = np.random.default_rng(2)
+    short = rng.integers(0, args.vocab - 1, 4).tolist()
+    long_p = rng.integers(0, args.vocab - 1, args.system_len).tolist()
+    r_short = eng.add_request(short, max_new_tokens=args.new_tokens)
+    for _ in range(2):                              # short reaches decode
+        eng.step()
+    r_long = eng.add_request(long_p, max_new_tokens=4)
+    step_times = []
+    while True:
+        t0 = time.perf_counter()
+        if not eng.step():
+            break
+        step_times.append(time.perf_counter() - t0)
+    outs = [eng._generated_of(r_short), eng._generated_of(r_long)]
+    return {"cell": f"chunked_budget_{budget}",
+            "max_step_ms": round(max(step_times) * 1e3, 3),
+            "mean_step_ms": round(float(np.mean(step_times)) * 1e3, 3),
+            "steps": len(step_times),
+            "max_chunk_tokens": eng.max_chunk_tokens}, outs
+
+
+def scenario_chunked(model, variables, args):
+    mono, mono_outs = _run_chunked_cell(model, variables, args,
+                                        budget=args.max_len)
+    emit(mono)
+    chunk, chunk_outs = _run_chunked_cell(model, variables, args,
+                                          budget=args.chunk_tokens)
+    emit(chunk)
+    identical = chunk_outs == mono_outs
+    ok = bool(identical
+              and chunk["max_step_ms"] < mono["max_step_ms"]
+              and chunk["max_chunk_tokens"] <= args.chunk_tokens)
+    emit({"cell": "chunked_verdict", "ok": ok,
+          "tokens_identical": bool(identical),
+          "max_step_speedup": round(mono["max_step_ms"]
+                                    / max(chunk["max_step_ms"], 1e-9), 2),
+          "budget_respected":
+              bool(chunk["max_chunk_tokens"] <= args.chunk_tokens)})
+    return ok
 
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", default="all",
+                    choices=["all", "batch", "prefix", "chunked"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
+    ap.add_argument("--system-len", type=int, default=96)
+    ap.add_argument("--tail-len", type=int, default=8)
+    ap.add_argument("--chunk-tokens", type=int, default=16)
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--vocab", type=int, default=128)
     ap.add_argument("--dim", type=int, default=64)
@@ -86,19 +244,16 @@ def main():
     ap.add_argument("--num-blocks", type=int, default=256)
     args = ap.parse_args()
 
-    model, variables, prompts = build(args)
-    seq, seq_outs = run_mode(model, variables, prompts, args, batched=False)
-    print(json.dumps(seq))
-    bat, bat_outs = run_mode(model, variables, prompts, args, batched=True)
-    print(json.dumps(bat))
-
-    identical = bat_outs == seq_outs        # greedy => exact, not approx
-    faster = bat["tok_s"] > seq["tok_s"]
-    print(json.dumps({
-        "cell": "TOTAL", "ok": bool(faster and identical),
-        "speedup": round(bat["tok_s"] / max(seq["tok_s"], 1e-9), 2),
-        "tokens_identical": bool(identical)}))
-    return 0 if (faster and identical) else 1
+    model, variables = build_model(args)
+    scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
+                 "chunked": scenario_chunked}
+    run = (list(scenarios) if args.scenario == "all"
+           else [args.scenario])
+    oks = {}
+    for name in run:
+        oks[name] = scenarios[name](model, variables, args)
+    emit({"cell": "TOTAL", "ok": all(oks.values()), **oks})
+    return 0 if all(oks.values()) else 1
 
 
 if __name__ == "__main__":
