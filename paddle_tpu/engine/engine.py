@@ -299,6 +299,10 @@ class ServeEngine:
             jnp.asarray(last_idx))
         logits = np.asarray(logits)
         computed = sum(ch.length for ch in chunks)
+        # per-event field: a request's prefix-hit tokens are attributed
+        # to the step its FIRST chunk runs (start == cached_tokens) and
+        # 0 on later chunks, so summing `cached` over a drain equals
+        # hit_tokens; cumulative rates ride `hit_rate`/stats()
         cached = sum(ch.req.cached_tokens for ch in chunks
                      if ch.start == ch.req.cached_tokens)
         self.prefill_tokens_computed += computed

@@ -173,7 +173,8 @@ class PagedKVCache:
         return need + revive <= len(self._free)
 
     # -- sequence lifecycle ----------------------------------------------
-    def alloc_sequence(self, seq_id: int, tokens: Sequence[int]) -> int:
+    def alloc_sequence(self, seq_id: int, tokens: Sequence[int],
+                       count_stats: bool = True) -> int:
         """Reserve blocks for a sequence's prompt, reusing committed
         prefix blocks from the index. Returns the number of CACHED
         tokens (KV already in the pool — the engine prefills only the
@@ -181,7 +182,10 @@ class PagedKVCache:
         always recomputes (its logits seed sampling); that write lands
         inside a shared block and COWs it. Raises CacheExhausted
         (allocating nothing) when the free list is short — the
-        scheduler turns that into deferred admission or preemption."""
+        scheduler turns that into deferred admission or preemption.
+        `count_stats=False` leaves hit_tokens/prompt_tokens untouched:
+        a preemption re-admission re-hits its own just-committed blocks
+        and would otherwise inflate hit_rate."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
         n = len(tokens)
@@ -205,8 +209,9 @@ class PagedKVCache:
         self._tokens[seq_id] = list(tokens)
         cached = min(len(matched) * self.block_size, n - 1)
         self._committed[seq_id] = cached
-        self.hit_tokens += cached
-        self.prompt_tokens += n
+        if count_stats:
+            self.hit_tokens += cached
+            self.prompt_tokens += n
         return cached
 
     def ensure_writable(self, seq_id: int, start: int, end: int) -> None:
@@ -298,20 +303,28 @@ class PagedKVCache:
         zero return to the free list but KEEP their prefix-index entry
         (cached-free): a later prompt with the same prefix revives them
         instead of recomputing, and `_pop_free` lazily evicts the entry
-        only when the pool reuses the block for fresh content. Returns
-        how many blocks went back to the free list (shared ones live
-        on)."""
+        only when the pool reuses the block for fresh content. Queued
+        COW copies targeting a freed block are cancelled — the pool may
+        hand the block straight back out, and a stale copy flushing
+        later would clobber the new owner's KV. Returns how many blocks
+        went back to the free list (shared ones live on)."""
         blocks = self._tables.pop(seq_id, [])
         self._lens.pop(seq_id, None)
         self._tokens.pop(seq_id, None)
         self._committed.pop(seq_id, None)
         freed = 0
+        freed_set = set()
         for b in blocks:
             self._refs[b] -= 1
             if self._refs[b] == 0:
                 del self._refs[b]
                 self._free.append(b)
                 freed += 1
+                freed_set.add(b)
+        if freed_set and self._pending_copies:
+            self._pending_copies = [
+                (s, d) for s, d in self._pending_copies
+                if d not in freed_set]
         return freed
 
     # -- views for the jitted step ---------------------------------------

@@ -182,7 +182,10 @@ class Scheduler:
                     or not self.cache.can_allocate(req.tokens)):
                 break       # FIFO: don't skip ahead of the head request
             self.waiting.popleft()
-            cached = self.cache.alloc_sequence(req.req_id, req.tokens)
+            # re-admissions re-hit their own committed blocks; don't let
+            # that inflate the prefix-cache hit rate
+            cached = self.cache.alloc_sequence(
+                req.req_id, req.tokens, count_stats=req.preemptions == 0)
             req.prefill_pos = cached
             req.cached_tokens = cached
             req.state = RUNNING
@@ -210,7 +213,11 @@ class Scheduler:
             req.prefill_pos += take
             budget -= take
             chunks.append(PrefillChunk(req, start, take))
-        return chunks
+        # a later row's COW starvation may have evicted an
+        # ALREADY-planned request (_pick_victim considers every running
+        # row): its table is freed and prefill_pos reset, so its chunk
+        # must not reach the engine
+        return [c for c in chunks if c.req in self.running]
 
     def _ensure_writable_or_preempt(self, req: Request, start: int,
                                     end: int) -> None:

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
-from paddle_tpu.engine import CacheExhausted, PagedKVCache, ServeEngine
+from paddle_tpu.engine import (PagedKVCache, Request, Scheduler,
+                               ServeEngine)
 from paddle_tpu.models.transformer import CausalLM
 
 pytestmark = pytest.mark.serve
@@ -128,6 +129,68 @@ class TestPrefixSharing:
         c.free_sequence(2)
         assert c.alloc_sequence(3, toks) == 0    # cached content is gone
 
+    def test_free_sequence_cancels_pending_cow_copies(self):
+        """Freeing a sequence cancels its queued COW copies: the dst
+        block goes back on the free list and may be handed straight to
+        another sequence, so a stale copy flushing later would clobber
+        the new owner's KV. Copies whose dst is still live survive."""
+        c = _cache()
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.alloc_sequence(2, toks)                # full hit, shared blocks
+        c.alloc_sequence(3, toks)
+        c.ensure_writable(2, 7, 8)               # COW queues (src, dst2)
+        c.ensure_writable(3, 7, 8)               # COW queues (src, dst3)
+        dst3 = c.block_table(3)[1]
+        c.free_sequence(2)                       # preempt-style drop
+        assert c.drain_copies() == [(c.block_table(1)[1], dst3)]
+        c.free_sequence(1)
+        c.free_sequence(3)
+        c.assert_quiesced()
+
+    def test_readmission_alloc_can_skip_stats(self):
+        """count_stats=False (scheduler re-admission after preemption)
+        leaves hit_tokens/prompt_tokens untouched so re-hitting a
+        request's own committed blocks can't inflate hit_rate."""
+        c = _cache()
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.free_sequence(1)
+        assert c.alloc_sequence(2, toks, count_stats=False) == 7
+        assert (c.hit_tokens, c.prompt_tokens) == (0, 8)
+        assert c.hit_rate() == 0.0
+
+
+# -- scheduler-level: mid-plan preemption ---------------------------------
+
+def test_plan_drops_chunk_of_request_preempted_mid_plan():
+    """A COW-starved row evicts the last-admitted running request —
+    which can be an EARLIER row of the same planning pass. The victim's
+    chunk must leave the plan: its block table is freed and its
+    prefill_pos reset, so executing the stale chunk would dereference
+    freed (possibly reallocated) blocks."""
+    cache = _cache(num_blocks=4)                 # 3 usable blocks
+    sched = Scheduler(cache, max_batch_size=2, max_prefill_tokens=64)
+    prefix = list(range(8))                      # 2 full blocks
+    cache.alloc_sequence(99, prefix)             # seed cached-free prefix
+    cache.commit_prefill(99, 8)
+    cache.free_sequence(99)
+    b = Request(prompt=prefix + [90, 91, 92, 93])
+    cx = Request(prompt=prefix)                  # exact-prefix full hit
+    sched.add(b)
+    sched.add(cx)
+    # admission: b revives the prefix + 1 fresh block (pool now empty),
+    # cx rides the shared prefix; cx's capped last token needs a COW,
+    # starves, and evicts b — whose chunk was already planned
+    kind, chunks = sched.next_batch()
+    assert kind == "prefill"
+    assert [ch.req for ch in chunks] == [cx]
+    assert all(ch.req in sched.running for ch in chunks)
+    assert b in sched.waiting and b.state == "waiting"
+    assert b.prefill_pos == 0
+
 
 # -- engine-level: sharing is invisible -----------------------------------
 
@@ -198,7 +261,31 @@ def test_preemption_with_sharing_keeps_siblings_intact(model_and_vars):
     got = tight.generate(prompts, max_new_tokens=12)
     assert sum(r.preemptions for r in tight.finished.values()) > 0
     assert got == want
+    # re-admissions after preemption must not inflate the hit stats:
+    # only first admissions count
+    assert tight.cache.prompt_tokens == sum(map(len, prompts))
     tight.cache.assert_quiesced()
+
+
+def test_mid_plan_preemption_end_to_end(model_and_vars):
+    """End-to-end repro of the stale-chunk hazard: a full-hit prompt's
+    COW starves during chunk planning and evicts a filler request whose
+    chunk was planned earlier in the SAME pass. The drain must complete
+    (no freed-table dereference) and every request — including the
+    preempted one — must reproduce its solo output exactly."""
+    model, variables = model_and_vars
+    prefix = SYSTEM[:8]                          # 2 full blocks
+    prompts = [prefix + [21, 22, 23, 24],        # revives cached prefix
+               [40 + i for i in range(12)],      # filler: drains the pool
+               prefix]                           # full hit -> COW starves
+    solo = [_engine(model, variables).generate([p], max_new_tokens=4)[0]
+            for p in prompts]
+    eng = _engine(model, variables, max_batch_size=3, num_blocks=7)
+    eng.generate([prefix], max_new_tokens=2)     # seed cached-free prefix
+    got = eng.generate(prompts, max_new_tokens=4)
+    assert got == solo
+    assert sum(r.preemptions for r in eng.finished.values()) >= 1
+    eng.cache.assert_quiesced()
 
 
 # -- engine-level: chunking is invisible ----------------------------------
