@@ -9,7 +9,9 @@ through block tables (kernels/paged_attention.py).
 
 from paddle_tpu.engine.engine import ServeEngine, serve_metadata
 from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
-from paddle_tpu.engine.scheduler import PrefillChunk, Request, Scheduler
+from paddle_tpu.engine.scheduler import (PrefillChunk, Request, Scheduler,
+                                         StepRow)
 
 __all__ = ["ServeEngine", "serve_metadata", "PagedKVCache",
-           "CacheExhausted", "Scheduler", "Request", "PrefillChunk"]
+           "CacheExhausted", "Scheduler", "Request", "StepRow",
+           "PrefillChunk"]

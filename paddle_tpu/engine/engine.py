@@ -2,22 +2,26 @@
 
 Ties the subsystem together (ENGINE.md): a refcounted `PagedKVCache`
 holds KV state in block pools (prefix-shared, copy-on-write), a
-`Scheduler` plans one prefill-chunk or decode batch per step, and this
-engine compiles + executes the steps, samples tokens host-side,
-streams them to per-request callbacks, and emits structured
+`Scheduler` plans one MIXED batch per step (decode rows + prefill
+chunks), and this engine compiles + executes the steps, samples tokens
+host-side, streams them to per-request callbacks, and emits structured
 `serve_event` JSON (utils/log.py) for observability.
 
 Shape discipline — the one-compilation rule: continuous batching
 mutates batch membership every step, which naively means a fresh XLA
 compile every step. Instead every device call runs at a FIXED shape:
 
-- decode is always [max_batch_size] rows; empty rows are padding that
-  reads/writes the reserved scratch block 0 (context_len 1, slot 0) so
-  they can never touch a live sequence. One compile, ever.
-- prefill chunks are always [max_batch_size, C] with C bucketed to the
-  next power of two — one compile per bucket, O(log chunk_budget)
-  total. A prefix-cache hit or a chunk boundary only changes the
-  row's start offset (an int32 operand), never the shape.
+- EVERY step is one flat ragged launch: the step's rows — decode rows
+  (a 1-token window) and prefill chunks (a budget-bounded window of
+  the prompt) — are packed into a single [T] token array, T =
+  round_up(chunk_budget, tile_q) + max_batch_size * tile_q, with each
+  row's tokens in a tile_q-aligned segment. Per-tile metadata maps
+  tiles back to rows (kernels/paged_attention.py
+  `ragged_paged_attention`). Row membership, chunk boundaries and
+  prefix-cache hits only change int32 operands, never the shape: ONE
+  compile, ever — no more pow2 chunk buckets and no separate decode
+  step. Pad positions scatter to the reserved scratch block 0
+  (context_len 1, slot 0) so they can never touch a live sequence.
 - COW block copies run through one fixed-width compiled
   gather/scatter (`_copy_blocks`); unused lanes copy scratch block 0
   onto itself.
@@ -49,7 +53,7 @@ import numpy as np
 
 from paddle_tpu.core.module import Context, _CtxCore
 from paddle_tpu.engine.paged_cache import PagedKVCache
-from paddle_tpu.engine.scheduler import PrefillChunk, Request, Scheduler
+from paddle_tpu.engine.scheduler import Request, Scheduler, StepRow
 from paddle_tpu.utils.log import serve_event
 
 _COPY_LANES = 8     # COW copies flushed through one fixed-shape call
@@ -58,13 +62,6 @@ _COPY_LANES = 8     # COW copies flushed through one fixed-shape call
 def _fresh_cx(variables) -> Context:
     return Context(_CtxCore(mode="apply", variables=variables, mutated={},
                             rng=None, rng_count=0, training=False))
-
-
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
 
 
 def serve_metadata(model) -> dict:
@@ -109,27 +106,53 @@ class ServeEngine:
     """Continuous-batching serve loop over a CausalLM.
 
     add_request() enqueues; step() advances the world by one scheduler
-    plan (one prefill-chunk or decode batch); run() drains the queue.
-    Token callbacks fire as tokens are sampled — streaming falls out of
-    iteration-level scheduling for free.
+    plan — ONE mixed batch of decode rows and prefill chunks through a
+    single compiled call; run() drains the queue. Token callbacks fire
+    as tokens are sampled — streaming falls out of iteration-level
+    scheduling for free.
 
     `max_prefill_tokens` is the per-step CHUNK budget: prompts longer
     than it are admitted anyway and prefilled across several steps,
-    interleaved with decode steps. `enable_prefix_cache=False` turns
-    off block sharing (the serve_bench baseline)."""
+    with decode rows riding the same steps. Budgets above the model's
+    usable context are clamped (a chunk can never exceed max_seq_len
+    anyway); budgets < 1 are rejected. `tile_q` is the ragged
+    packing's query-tile granularity: every row occupies a
+    tile_q-aligned segment of the flat step, so each planned row
+    wastes at most tile_q - 1 query slots. `enable_prefix_cache=False`
+    turns off block sharing (the serve_bench baseline)."""
 
     def __init__(self, model, variables, max_batch_size: int = 4,
                  block_size: int = 16, num_blocks: int = 256,
                  max_seq_len: Optional[int] = None,
                  max_prefill_tokens: int = 512,
-                 min_prefill_bucket: int = 16,
+                 tile_q: int = 8,
                  enable_prefix_cache: bool = True):
         self.model = model
         self.variables = variables
         attn = model.blocks[0].attn
         self.max_seq_len = min(max_seq_len or model.max_len, model.max_len)
         self.max_batch_size = max_batch_size
-        self.min_prefill_bucket = min_prefill_bucket
+        if max_prefill_tokens < 1:
+            raise ValueError(
+                f"max_prefill_tokens {max_prefill_tokens} < 1: the chunk "
+                "budget must admit at least one prompt token per step")
+        if tile_q < 1:
+            raise ValueError(f"tile_q {tile_q} < 1")
+        if max_prefill_tokens > self.max_seq_len:
+            # a single chunk can never exceed the usable context, so a
+            # larger budget only inflates the compiled step shape —
+            # clamp loudly instead of silently paying for dead tiles
+            serve_event("serve_config_clamp", field="max_prefill_tokens",
+                        requested=max_prefill_tokens,
+                        clamped_to=self.max_seq_len)
+            max_prefill_tokens = self.max_seq_len
+        self.tile_q = tile_q
+        # flat step sizing: every row's segment is tile-aligned, so the
+        # worst case is max_batch_size rows each wasting tile_q - 1
+        # slots on top of the chunk budget
+        self.flat_tokens = (-(-max_prefill_tokens // tile_q) * tile_q
+                            + max_batch_size * tile_q)
+        self.num_tiles = self.flat_tokens // tile_q
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
@@ -150,18 +173,13 @@ class ServeEngine:
         model_ = model
 
         @jax.jit
-        def _prefill_chunk(variables, tokens, start_pos, pools,
-                           block_tables, context_lens, slots, last_idx):
-            return model_.prefill_chunk_paged(
-                _fresh_cx(variables), tokens, start_pos, pools,
-                block_tables, context_lens, slots, last_idx)
-
-        @jax.jit
-        def _decode(variables, tokens, positions, pools, block_tables,
-                    context_lens, slots):
-            return model_.decode_step_paged(
+        def _step_fn(variables, tokens, positions, pools, block_tables,
+                     context_lens, q_starts, tile_rows, tile_offs, slots,
+                     last_idx):
+            return model_.ragged_step_paged(
                 _fresh_cx(variables), tokens, positions, pools,
-                block_tables, context_lens, slots)
+                block_tables, context_lens, q_starts, tile_rows,
+                tile_offs, slots, last_idx)
 
         @jax.jit
         def _copy_blocks(pools, src, dst):
@@ -170,8 +188,7 @@ class ServeEngine:
             return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
                     for kp, vp in pools]
 
-        self._prefill_chunk = _prefill_chunk
-        self._decode = _decode
+        self._step_fn = _step_fn
         self._copy_blocks = _copy_blocks
 
     # -- construction from an exported artifact ---------------------------
@@ -232,16 +249,13 @@ class ServeEngine:
 
     # -- serve loop --------------------------------------------------------
     def step(self) -> bool:
-        """Advance one scheduler plan. Returns False when idle."""
-        plan = self.scheduler.next_batch()
-        if plan is None:
+        """Advance one scheduler plan (one mixed batch through the
+        single compiled step). Returns False when idle."""
+        rows = self.scheduler.next_batch()
+        if rows is None:
             return False
-        kind, work = plan
         self.steps += 1
-        if kind == "prefill":
-            self._step_prefill(work)
-        else:
-            self._step_decode(work)
+        self._step_mixed(rows)
         self.peak_occupancy = max(self.peak_occupancy,
                                   self.cache.occupancy())
         return True
@@ -268,91 +282,99 @@ class ServeEngine:
             self.cache.pools = self._copy_blocks(
                 self.cache.pools, jnp.asarray(src), jnp.asarray(dst))
 
-    def _step_prefill(self, chunks: List[PrefillChunk]) -> None:
+    def _step_mixed(self, rows: List[StepRow]) -> None:
+        """Pack the plan's rows — decode rows AND prefill chunks — into
+        the flat ragged layout and run ONE compiled step. Row i's token
+        window [start, start+length) lands in a tile_q-aligned segment
+        of the [T] arrays; per-row metadata (block table, chunk-end
+        context, start position) sits at index i, and the null row at
+        index max_batch_size backs pad tiles (ctx 1, scratch table).
+        For a decode row the window is [seq_len, seq_len+1) of
+        req.tokens — i.e. exactly the last generated token at its
+        next-token position, which is what the old decode step fed."""
         self._flush_cow()
-        n = self.max_batch_size
-        mb = self.max_blocks_per_seq
-        c_real = max(ch.length for ch in chunks)
-        c_pad = max(_next_pow2(c_real), self.min_prefill_bucket)
-        c_pad = min(c_pad, self.model.max_len)   # bucket cap: pe table
-        tokens = np.zeros((n, c_pad), np.int32)
-        start_pos = np.zeros((n,), np.int32)
-        last_idx = np.zeros((n,), np.int32)
-        context_lens = np.ones((n,), np.int32)   # pad rows: scratch slot 0
-        block_tables = np.zeros((n, mb), np.int32)
-        # pad rows / positions scatter into scratch block 0 (slot < bs)
-        slots = np.zeros((n * c_pad,), np.int32)
-        for i, ch in enumerate(chunks):
-            toks = ch.req.tokens
-            tokens[i, :ch.length] = toks[ch.start:ch.start + ch.length]
-            start_pos[i] = ch.start
-            last_idx[i] = ch.length - 1
-            context_lens[i] = ch.start + ch.length
-            block_tables[i] = self.cache.padded_table(ch.req.req_id, mb)
-            for p in range(ch.length):
-                slots[i * c_pad + p] = self.cache.slot_of(ch.req.req_id,
-                                                          ch.start + p)
-        logits, self.cache.pools = self._prefill_chunk(
-            self.variables, jnp.asarray(tokens), jnp.asarray(start_pos),
-            self.cache.pools, jnp.asarray(block_tables),
-            jnp.asarray(context_lens), jnp.asarray(slots),
-            jnp.asarray(last_idx))
-        logits = np.asarray(logits)
-        computed = sum(ch.length for ch in chunks)
-        # per-event field: a request's prefix-hit tokens are attributed
-        # to the step its FIRST chunk runs (start == cached_tokens) and
-        # 0 on later chunks, so summing `cached` over a drain equals
-        # hit_tokens; cumulative rates ride `hit_rate`/stats()
-        cached = sum(ch.req.cached_tokens for ch in chunks
-                     if ch.start == ch.req.cached_tokens)
-        self.prefill_tokens_computed += computed
-        self.max_chunk_tokens = max(self.max_chunk_tokens, computed)
-        now = time.monotonic()
-        for i, ch in enumerate(chunks):
-            r = ch.req
-            self.cache.commit_prefill(r.req_id, ch.start + ch.length)
-            if ch.start + ch.length == len(r.prompt):   # final chunk
-                tok = _sample(logits[i], r, len(r.prompt))
-                if not r.first_token_time:
-                    r.first_token_time = now
-                self._emit_token(r, tok)
-        serve_event("serve_prefill", batch=len(chunks), padded_t=c_pad,
-                    tokens=computed, cached=cached, step=self.steps,
-                    cow=self.cache.cow_copies,
-                    shared_blocks=self.cache.shared_blocks,
-                    hit_rate=round(self.cache.hit_rate(), 4),
-                    occupancy=round(self.cache.occupancy(), 4),
-                    queue_depth=self.scheduler.queue_depth)
-
-    def _step_decode(self, reqs: List[Request]) -> None:
-        self._flush_cow()
+        t_flat, tq, nt = self.flat_tokens, self.tile_q, self.num_tiles
         b = self.max_batch_size
         mb = self.max_blocks_per_seq
-        tokens = np.zeros((b,), np.int32)
-        positions = np.zeros((b,), np.int32)
-        context_lens = np.ones((b,), np.int32)   # pad rows: 1 token of scratch
-        block_tables = np.zeros((b, mb), np.int32)
-        slots = np.zeros((b,), np.int32)
-        for i, r in enumerate(reqs):
-            pos = self.cache.seq_len(r.req_id)   # next-token position
-            tokens[i] = r.generated[-1]
-            positions[i] = pos
-            context_lens[i] = pos + 1
+        tokens = np.zeros((t_flat,), np.int32)
+        positions = np.zeros((t_flat,), np.int32)
+        # pad positions scatter into scratch block 0 (slot < bs)
+        slots = np.zeros((t_flat,), np.int32)
+        block_tables = np.zeros((b + 1, mb), np.int32)
+        context_lens = np.ones((b + 1,), np.int32)   # null/pad rows: scratch
+        q_starts = np.zeros((b + 1,), np.int32)
+        tile_rows = np.full((nt,), b, np.int32)      # pad tiles -> null row
+        tile_offs = np.zeros((nt,), np.int32)
+        last_idx = np.zeros((b,), np.int32)
+        cursor = 0
+        for i, row in enumerate(rows):
+            r = row.req
+            toks = r.tokens
+            tokens[cursor:cursor + row.length] = \
+                toks[row.start:row.start + row.length]
+            positions[cursor:cursor + row.length] = np.arange(
+                row.start, row.start + row.length, dtype=np.int32)
+            for p in range(row.length):
+                slots[cursor + p] = self.cache.slot_of(r.req_id,
+                                                       row.start + p)
             block_tables[i] = self.cache.padded_table(r.req_id, mb)
-            slots[i] = self.cache.slot_of(r.req_id, pos)
-        logits, self.cache.pools = self._decode(
+            context_lens[i] = row.start + row.length
+            q_starts[i] = row.start
+            last_idx[i] = cursor + row.length - 1
+            ntiles = -(-row.length // tq)
+            t0 = cursor // tq
+            for k in range(ntiles):
+                tile_rows[t0 + k] = i
+                tile_offs[t0 + k] = k * tq
+            cursor += ntiles * tq
+        logits, self.cache.pools = self._step_fn(
             self.variables, jnp.asarray(tokens), jnp.asarray(positions),
             self.cache.pools, jnp.asarray(block_tables),
-            jnp.asarray(context_lens), jnp.asarray(slots))
+            jnp.asarray(context_lens), jnp.asarray(q_starts),
+            jnp.asarray(tile_rows), jnp.asarray(tile_offs),
+            jnp.asarray(slots), jnp.asarray(last_idx))
         logits = np.asarray(logits)
-        for i, r in enumerate(reqs):
-            # the step wrote r.generated[-1]'s k/v at the reserved slot
-            self.cache.advance(r.req_id, r.generated[-1])
-            tok = _sample(logits[i], r, self.cache.seq_len(r.req_id))
-            self._emit_token(r, tok)
-        serve_event("serve_decode", batch=len(reqs), step=self.steps,
-                    occupancy=round(self.cache.occupancy(), 4),
-                    queue_depth=self.scheduler.queue_depth)
+        chunks = [w for w in rows if not w.decode]
+        decodes = [w for w in rows if w.decode]
+        computed = sum(w.length for w in chunks)
+        now = time.monotonic()
+        for i, row in enumerate(rows):
+            r = row.req
+            if row.decode:
+                # the step wrote r.generated[-1]'s k/v at the reserved
+                # slot
+                self.cache.advance(r.req_id, r.generated[-1])
+                tok = _sample(logits[i], r, self.cache.seq_len(r.req_id))
+                self._emit_token(r, tok)
+            else:
+                self.cache.commit_prefill(r.req_id, row.start + row.length)
+                if row.start + row.length == len(r.prompt):  # final chunk
+                    tok = _sample(logits[i], r, len(r.prompt))
+                    if not r.first_token_time:
+                        r.first_token_time = now
+                    self._emit_token(r, tok)
+        if chunks:
+            # per-event field: a request's prefix-hit tokens are
+            # attributed to the step its FIRST chunk runs
+            # (start == cached_tokens) and 0 on later chunks, so summing
+            # `cached` over a drain equals hit_tokens; cumulative rates
+            # ride `hit_rate`/stats()
+            cached = sum(w.req.cached_tokens for w in chunks
+                         if w.start == w.req.cached_tokens)
+            self.prefill_tokens_computed += computed
+            self.max_chunk_tokens = max(self.max_chunk_tokens, computed)
+            serve_event("serve_prefill", batch=len(chunks),
+                        flat_t=t_flat, tokens=computed, cached=cached,
+                        step=self.steps, cow=self.cache.cow_copies,
+                        shared_blocks=self.cache.shared_blocks,
+                        hit_rate=round(self.cache.hit_rate(), 4),
+                        occupancy=round(self.cache.occupancy(), 4),
+                        queue_depth=self.scheduler.queue_depth)
+        if decodes:
+            serve_event("serve_decode", batch=len(decodes),
+                        step=self.steps,
+                        occupancy=round(self.cache.occupancy(), 4),
+                        queue_depth=self.scheduler.queue_depth)
 
     def _emit_token(self, req: Request, tok: int) -> None:
         req.generated.append(tok)

@@ -6,8 +6,8 @@ finishes, then admits the next — short requests wait on the longest
 one, and freed KV memory idles. Continuous batching reschedules every
 STEP: finished sequences leave the running set immediately, waiting
 requests are admitted the moment blocks free up, and each step the
-scheduler hands the engine either one prefill-chunk batch or one
-decode batch.
+scheduler hands the engine ONE MIXED plan — every decode-ready row
+plus budget-bounded prefill chunks, packed into the same launch.
 
 Policy (simple and deterministic, ENGINE.md §scheduler):
 
@@ -16,14 +16,18 @@ Policy (simple and deterministic, ENGINE.md §scheduler):
   shrink the bill). Admission allocates the WHOLE prompt's blocks and
   records how many leading tokens the prefix cache already holds —
   those are never prefilled.
-- CHUNKED PREFILL: the uncached tail of an admitted prompt is
-  prefilled in chunks of at most `max_prefill_tokens` tokens. When
-  both prefill work and decode-ready sequences exist, the scheduler
-  ALTERNATES chunk and decode steps, so one long prompt can neither
-  starve running decodes (inter-token latency stays bounded at one
-  chunk) nor be starved by them (TTFT stays bounded too). A request
-  whose final chunk ran becomes decode-ready (the engine samples its
-  first token from that chunk's logits).
+- MIXED STEPS: every step carries one row per running request — a
+  decode row (its next token) for decode-ready sequences, a prefill
+  chunk of at most `max_prefill_tokens` total tokens for sequences
+  still prefilling (Sarathi-style piggybacking). A long prompt can
+  never starve running decodes (they advance EVERY step) and is never
+  starved by them (every step also moves its prefill forward), so
+  both inter-token latency and TTFT stay bounded without the old
+  chunk/decode alternation. A request whose final chunk ran becomes
+  decode-ready (the engine samples its first token from that chunk's
+  logits). A decode row is just the 1-token window
+  [seq_len, seq_len+1) of req.tokens — the engine packs both row
+  kinds into one flat launch (kernels/paged_attention.py ragged).
 - Preemption by recompute: when a decode append or a COW copy needs a
   block and the pool is empty, the LAST-admitted running request is
   evicted — its blocks are dropped (refcounts) and it rejoins the
@@ -42,7 +46,7 @@ from __future__ import annotations
 import itertools
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional, Tuple, Union
+from typing import Callable, List, Optional
 
 from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
 
@@ -92,23 +96,30 @@ class Request:
 
 
 @dataclass
-class PrefillChunk:
-    """One row of a prefill-chunk batch: prefill `req`'s prompt
-    positions [start, start + length)."""
+class StepRow:
+    """One row of a mixed step: run `req`'s token window
+    [start, start + length). decode=True is the 1-token next-token
+    window of a decode-ready sequence (its block already reserved);
+    decode=False is a prefill chunk of the prompt."""
     req: Request
     start: int
     length: int
+    decode: bool = False
 
 
-Plan = Tuple[str, Union[List[Request], List[PrefillChunk]]]
+# back-compat alias: a prefill chunk is a StepRow with decode=False
+PrefillChunk = StepRow
+
+Plan = List[StepRow]
 
 
 class Scheduler:
-    """Decides, per engine step, what work runs: a prefill-chunk batch
-    or a decode batch. Bounds: `max_batch_size` concurrent running
-    sequences (the engine compiles its decode step for exactly this
-    batch), `max_prefill_tokens` prompt tokens per prefill-chunk step,
-    `max_seq_len` ceiling on prompt+generation."""
+    """Decides, per engine step, what work runs: one mixed plan of
+    decode rows and prefill chunks. Bounds: `max_batch_size` concurrent
+    running sequences (the engine packs exactly this many rows into its
+    compiled step), `max_prefill_tokens` prompt tokens per step's
+    chunks (decode rows ride free), `max_seq_len` ceiling on
+    prompt+generation."""
 
     def __init__(self, cache: PagedKVCache, max_batch_size: int = 8,
                  max_prefill_tokens: int = 512, max_seq_len: int = 2048):
@@ -118,7 +129,6 @@ class Scheduler:
         self.max_seq_len = max_seq_len
         self.waiting: deque[Request] = deque()
         self.running: List[Request] = []
-        self._prefer_decode = False     # chunk/decode alternation state
         # engine hook, fired after a preemption moves a req back to waiting
         self.on_preempt: Optional[Callable[[Request], None]] = None
 
@@ -139,39 +149,51 @@ class Scheduler:
 
     # -- planning ---------------------------------------------------------
     def next_batch(self) -> Optional[Plan]:
-        """Plan one step: ("prefill", [PrefillChunk]) | ("decode",
-        running) | None when idle. Admission allocates cache blocks
+        """Plan one MIXED step: a list of StepRows (decode rows plus
+        prefill chunks, one row per running request, in admission
+        order) or None when idle. Admission allocates cache blocks
         (prefix hits included) and moves requests to RUNNING; chunk
         planning advances `prefill_pos` optimistically (the engine
-        always executes the plan it is handed); decode planning
-        guarantees every decode-ready sequence has its next-token block
-        reserved, preempting if the pool runs dry."""
+        always executes the plan it is handed); every decode row has
+        its next-token block reserved before it enters the plan,
+        preempting from the tail if the pool runs dry. The chunk token
+        budget goes to the head request first, so earlier prompts
+        reach their first token sooner."""
         self._try_admit()
-        prefilling = [r for r in self.running if r.prefilling]
-        decoding = [r for r in self.running if not r.prefilling]
-        if prefilling and decoding:
-            kind = "decode" if self._prefer_decode else "prefill"
-        elif prefilling:
-            kind = "prefill"
-        elif decoding:
-            kind = "decode"
-        else:
+        if not self.running:
             self._check_liveness()
             return None
-
-        if kind == "prefill":
-            chunks = self._plan_chunks(prefilling)
-            if chunks:
-                self._prefer_decode = True
-                return ("prefill", chunks)
-            kind = "decode" if decoding else None   # chunk COW starved
-        if kind == "decode":
-            self._reserve_decode_blocks(decoding)
-            decoding = [r for r in decoding if r in self.running]
-            if decoding:
-                self._prefer_decode = False
-                return ("decode", decoding)
+        rows: List[StepRow] = []
+        budget = self.max_prefill_tokens
+        for req in list(self.running):
+            if req not in self.running:     # preempted by an earlier row
+                continue
+            if req.prefilling:
+                if budget <= 0:
+                    continue
+                take = min(len(req.prompt) - req.prefill_pos, budget)
+                start = req.prefill_pos
+                # COW (a chunk writing into a shared block) may need a
+                # free block; a dry pool preempts from the tail
+                self._ensure_writable_or_preempt(req, start, start + take)
+                req.prefill_pos += take
+                budget -= take
+                rows.append(StepRow(req, start, take, decode=False))
+            else:
+                if self._reserve_decode_block(req):
+                    rows.append(StepRow(
+                        req, self.cache.seq_len(req.req_id), 1,
+                        decode=True))
+        # a later row's block starvation may have evicted an
+        # ALREADY-planned request (_pick_victim considers every running
+        # row): its table is freed and prefill_pos reset, so its row
+        # must not reach the engine
+        rows = [w for w in rows if w.req in self.running]
+        if rows:
+            return rows
+        if self.running:
             return self.next_batch()    # everything preempted; replan
+        self._check_liveness()
         return None
 
     def _try_admit(self) -> List[Request]:
@@ -193,32 +215,6 @@ class Scheduler:
         self.running.extend(admitted)
         return admitted
 
-    def _plan_chunks(self, prefilling: List[Request]) -> List[PrefillChunk]:
-        """Token-budget-bounded chunk batch over prefilling requests in
-        admission order; one row per request, whole budget to the head
-        request first so earlier prompts reach their first token
-        sooner. COW (a chunk writing into a shared block) may need a
-        free block; the pool running dry preempts from the tail like
-        decode does."""
-        chunks: List[PrefillChunk] = []
-        budget = self.max_prefill_tokens
-        for req in list(prefilling):
-            if budget <= 0 or len(chunks) >= self.max_batch_size:
-                break
-            if req not in self.running:     # preempted by an earlier COW
-                continue
-            take = min(len(req.prompt) - req.prefill_pos, budget)
-            start = req.prefill_pos
-            self._ensure_writable_or_preempt(req, start, start + take)
-            req.prefill_pos += take
-            budget -= take
-            chunks.append(PrefillChunk(req, start, take))
-        # a later row's COW starvation may have evicted an
-        # ALREADY-planned request (_pick_victim considers every running
-        # row): its table is freed and prefill_pos reset, so its chunk
-        # must not reach the engine
-        return [c for c in chunks if c.req in self.running]
-
     def _ensure_writable_or_preempt(self, req: Request, start: int,
                                     end: int) -> None:
         """COW the chunk's target blocks, evicting tail requests (never
@@ -233,21 +229,22 @@ class Scheduler:
                     raise
                 self.preempt(victim)
 
-    def _reserve_decode_blocks(self, decoding: List[Request]) -> None:
-        """Ensure every decode-ready sequence can hold one more token,
-        evicting from the tail (last admitted) until allocation holds."""
-        for req in decoding:
-            while req in self.running:
-                try:
-                    self.cache.append_token(req.req_id)
-                    break
-                except CacheExhausted:
-                    victim = self._pick_victim(req)
-                    if victim is None:
-                        raise CacheExhausted(
-                            "single sequence exceeds total KV pool; "
-                            "increase num_blocks or lower max_seq_len")
-                    self.preempt(victim)
+    def _reserve_decode_block(self, req: Request) -> bool:
+        """Ensure a decode-ready sequence can hold one more token,
+        evicting from the tail (last admitted) until allocation holds.
+        Returns False when `req` itself was preempted along the way."""
+        while req in self.running:
+            try:
+                self.cache.append_token(req.req_id)
+                return True
+            except CacheExhausted:
+                victim = self._pick_victim(req)
+                if victim is None:
+                    raise CacheExhausted(
+                        "single sequence exceeds total KV pool; "
+                        "increase num_blocks or lower max_seq_len")
+                self.preempt(victim)
+        return False
 
     def _pick_victim(self, keep: Request) -> Optional[Request]:
         """Last-admitted running request other than `keep`; None when

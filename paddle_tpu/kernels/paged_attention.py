@@ -33,6 +33,7 @@ divide H; the grouped einsum reads each kv head once.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -50,6 +51,47 @@ from paddle_tpu.kernels.attention import reference_attention
 
 NEG_INF = -1e9
 LANES = 128   # online-softmax m/l scratch is lane-broadcast, as in flash.py
+
+
+@functools.lru_cache(maxsize=1)
+def _device_platform() -> str:
+    """The default device's platform, resolved once per process.
+    jax.devices() takes a lock and rebuilds the device list on every
+    call — too heavy for a per-dispatch check on the serve hot path."""
+    return jax.devices()[0].platform
+
+
+def _resolve_dispatch(use_kernel: Optional[bool],
+                      interpret: Optional[bool]) -> tuple:
+    """Shared kernel/reference/interpret tier selection for the paged
+    dispatchers. Explicit caller arguments win; with use_kernel=None the
+    PTPU_PAGED_KERNEL env var can force a tier (so the FULL engine path
+    can run through the kernel in interpret mode, not just unit tests):
+
+    - "kernel":    Pallas kernel, interpret off-TPU
+    - "interpret": Pallas kernel in interpret mode everywhere
+    - "reference": XLA reference everywhere
+    """
+    if use_kernel is None:
+        mode = os.environ.get("PTPU_PAGED_KERNEL", "").strip().lower()
+        if mode == "reference":
+            return False, False
+        if mode == "interpret":
+            return True, True
+        if mode == "kernel":
+            use_kernel = True
+        elif mode:
+            raise ValueError(
+                f"PTPU_PAGED_KERNEL={mode!r}: expected "
+                "kernel | reference | interpret")
+    on_tpu = _device_platform() == "tpu"
+    if use_kernel is None:
+        use_kernel = on_tpu
+    if not use_kernel:
+        return False, False
+    if interpret is None:
+        interpret = not on_tpu
+    return True, interpret
 
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, context_lens,
@@ -224,19 +266,223 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     """Dispatching entry point (the mha() of the paged path).
 
     use_kernel=None: Pallas kernel on TPU, XLA reference elsewhere —
-    the engine and model code call with defaults and get the right tier.
-    Tests pass use_kernel=True, interpret=True to validate the kernel's
-    numerics on CPU.
+    the engine and model code call with defaults and get the right tier
+    (PTPU_PAGED_KERNEL overrides; see _resolve_dispatch). Tests pass
+    use_kernel=True, interpret=True to validate the kernel's numerics
+    on CPU.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if use_kernel is None:
-        use_kernel = on_tpu
+    use_kernel, interpret = _resolve_dispatch(use_kernel, interpret)
     if not use_kernel:
         return paged_attention_reference(q, k_pool, v_pool, block_tables,
                                          context_lens, scale=scale)
-    if interpret is None:
-        interpret = not on_tpu
     return _paged_kernel_call(q, k_pool, v_pool, block_tables, context_lens,
                               scale, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged attention: ONE launch for a mixed prefill+decode batch.
+#
+# The serve engine packs every row of a step — decode rows (one query
+# token) and prefill chunks (a window of C query tokens) — into a
+# single flat query array q: [T, H, D]. Each row occupies a contiguous
+# segment aligned to TILE_Q tokens; slack positions inside a row's last
+# tile and whole unused tiles are padding. Per-TILE metadata maps the
+# packing back to sequences:
+#
+# - tile_rows [NT] int32: which metadata row each query tile belongs to
+#   (pad tiles point at a "null row" whose context_len is 1 and whose
+#   block table is all scratch block 0).
+# - tile_offs [NT] int32: the tile's token offset WITHIN its row's
+#   segment, so a query's absolute position is
+#   q_starts[row] + tile_off + (index inside the tile).
+# - block_tables [R, MB], context_lens [R], q_starts [R]: per-row pool
+#   block tables, chunk-end positions (start + q_len; 1 for the null
+#   row), and first-query positions. A decode row is simply q_len=1:
+#   q_start = ctx - 1.
+#
+# Masking is absolute-position causal AND context-bounded
+# (kv_pos <= q_pos, kv_pos < ctx — the paged_prefill_attention
+# contract), so decode rows, mid-prompt chunks and pad queries all fall
+# out of one rule: pad queries attend a finite prefix (never sampled),
+# and kv position 0 is always visible, so no softmax row is ever empty.
+# ---------------------------------------------------------------------------
+
+
+def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
+                                     context_lens, q_starts, tile_rows,
+                                     tile_offs,
+                                     scale: Optional[float] = None):
+    """XLA oracle for the ragged layout: expand tile metadata to
+    per-token rows and run the dense gather + masked attention.
+    q: [T, H, D] flat-packed; returns [T, H, D].
+
+    Gathers [T, MB*BS, Hkv, D] — heavier than the per-row [B, ...]
+    gathers above (every token re-gathers its row's blocks), but it is
+    the off-TPU dispatch tier where T stays small (CPU smoke + tests),
+    and XLA's masked softmax keeps it exactly batch-invariant."""
+    t, h, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    nt = tile_rows.shape[0]
+    if t % nt:
+        raise ValueError(f"flat length {t} not a multiple of {nt} tiles")
+    tq = t // nt
+    mb = block_tables.shape[1]
+    row_of = jnp.repeat(tile_rows, tq)                       # [T]
+    qpos = (jnp.repeat(q_starts[tile_rows] + tile_offs, tq)
+            + jnp.tile(jnp.arange(tq, dtype=jnp.int32), nt))  # [T]
+    k = k_pool[block_tables[row_of]].reshape(t, mb * bs, hkv, d)
+    v = v_pool[block_tables[row_of]].reshape(t, mb * bs, hkv, d)
+    kv_pos = jnp.arange(mb * bs, dtype=jnp.int32)
+    ctx = context_lens[row_of]
+    mask = ((kv_pos[None, :] <= qpos[:, None])
+            & (kv_pos[None, :] < ctx[:, None]))[:, None, None, :]
+    return reference_attention(q[:, None].astype(k.dtype), k, v, mask=mask,
+                               scale=scale)[:, 0].astype(q.dtype)
+
+
+def _ragged_kernel(bt_ref, cl_ref, qs_ref, tr_ref, to_ref,
+                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   scale: float, block_size: int, tile_q: int, groups: int):
+    """One (query-tile, kv-block) grid cell. q_ref: [TQ, H, D] — one
+    tile of the flat packing; k/v_ref: the pool block the index map
+    selected, [BS, Hkv, D]. Online-softmax scratch is flattened to
+    (TQ*H, ·) rows and persists across the sequential kv axis."""
+    t, j = pl.program_id(0), pl.program_id(1)
+    nblk = pl.num_programs(1)
+    row = tr_ref[t]
+    ctx = cl_ref[row]
+    q0 = qs_ref[row] + to_ref[t]        # absolute pos of the tile's 1st query
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip blocks entirely past the row's context OR entirely in the
+    # causal future of the tile's LAST query (position q0 + tile_q - 1)
+    @pl.when((j * block_size < ctx) & (j * block_size <= q0 + tile_q - 1))
+    def _compute():
+        q = q_ref[...]                                  # [TQ, H, D]
+        k = k_ref[...]                                  # [BS, Hkv, D]
+        v = v_ref[...]
+        tq, h, d = q.shape
+        hkv = k.shape[1]
+        # batch over kv heads: [Hkv, TQ*G, D] x [Hkv, BS, D]
+        qg = q.reshape(tq, hkv, groups, d).transpose(1, 0, 2, 3) \
+              .reshape(hkv, tq * groups, d)
+        kt = jnp.transpose(k, (1, 0, 2))                # [Hkv, BS, D]
+        s = jax.lax.dot_general(
+            qg, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [Hkv, TQ*G, BS]
+        s = s.reshape(hkv, tq, groups, block_size).transpose(1, 0, 2, 3) \
+             .reshape(tq * h, block_size)
+        qpos = q0 + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, h, block_size), 0).reshape(tq * h, block_size)
+        kpos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, h, block_size), 2).reshape(tq * h, block_size)
+        s = jnp.where((kpos <= qpos) & (kpos < ctx), s, NEG_INF)
+
+        m_prev = m_scr[...][:, :1]                      # [TQ*H, 1]
+        l_prev = l_scr[...][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # [TQ*H, BS]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pg = p.reshape(tq, hkv, groups, block_size).transpose(1, 0, 2, 3) \
+              .reshape(hkv, tq * groups, block_size)
+        vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, BS, D]
+        pv = jax.lax.dot_general(
+            pg.astype(v.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)         # [Hkv, TQ*G, D]
+        pv = pv.reshape(hkv, tq, groups, d).transpose(1, 0, 2, 3) \
+               .reshape(tq * h, d)
+        acc_scr[...] = alpha * acc_scr[...] + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nblk - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        o_ref[...] = (acc_scr[...] / jnp.maximum(l, 1e-30)).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _ragged_kernel_call(q, k_pool, v_pool, block_tables, context_lens,
+                        q_starts, tile_rows, tile_offs, scale,
+                        interpret: bool):
+    t, h, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = block_tables.shape[1]
+    nt = tile_rows.shape[0]
+    if t % nt:
+        raise ValueError(f"flat length {t} not a multiple of {nt} tiles")
+    tq = t // nt
+    if h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+
+    def _kv_block(ti, j, bt, cl, qs, tr, to):
+        # index-map gather WITH skip: inactive cells re-select block 0,
+        # which elides the DMA entirely when the previous cell already
+        # holds it (Pallas skips re-fetch on an unchanged block index)
+        row = tr[ti]
+        active = ((j * bs < cl[row])
+                  & (j * bs <= qs[row] + to[ti] + tq - 1))
+        return (jnp.where(active, bt[row, j], 0), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,  # block_tables, ctx_lens, q_starts, tiles x2
+        grid=(nt, mb),
+        in_specs=[
+            pl.BlockSpec((tq, h, d),
+                         lambda ti, j, bt, cl, qs, tr, to: (ti, 0, 0)),
+            pl.BlockSpec((None, bs, hkv, d), _kv_block),
+            pl.BlockSpec((None, bs, hkv, d), _kv_block),
+        ],
+        out_specs=pl.BlockSpec((tq, h, d),
+                               lambda ti, j, bt, cl, qs, tr, to: (ti, 0, 0)),
+        scratch_shapes=[
+            _scratch((tq * h, LANES)),
+            _scratch((tq * h, LANES)),
+            _scratch((tq * h, d)),
+        ],
+    )
+    kernel = functools.partial(_ragged_kernel, scale=scale, block_size=bs,
+                               tile_q=tq, groups=h // hkv)
+    compiler_params = None
+    if pltpu is not None:
+        cls = (getattr(pltpu, "CompilerParams", None)
+               or pltpu.TPUCompilerParams)
+        compiler_params = cls(dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, h, d), q.dtype),
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+      q_starts.astype(jnp.int32), tile_rows.astype(jnp.int32),
+      tile_offs.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
+                           q_starts, tile_rows, tile_offs,
+                           scale: Optional[float] = None,
+                           use_kernel: Optional[bool] = None,
+                           interpret: Optional[bool] = None):
+    """Mixed prefill+decode attention over the flat ragged packing —
+    the engine's single-step entry point. Dispatch tiers mirror
+    paged_attention: Pallas kernel on TPU, XLA reference elsewhere,
+    PTPU_PAGED_KERNEL / explicit flags override."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    use_kernel, interpret = _resolve_dispatch(use_kernel, interpret)
+    if not use_kernel:
+        return ragged_paged_attention_reference(
+            q, k_pool, v_pool, block_tables, context_lens, q_starts,
+            tile_rows, tile_offs, scale=scale)
+    return _ragged_kernel_call(q, k_pool, v_pool, block_tables,
+                               context_lens, q_starts, tile_rows, tile_offs,
+                               scale, interpret)

@@ -251,6 +251,42 @@ class MultiHeadAttention(Module):
         out = self.out_proj(cx, out.reshape(b, c, self.model_dim))
         return out, (k_pool, v_pool)
 
+    def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
+                          block_tables, context_lens, q_starts, tile_rows,
+                          tile_offs, slots):
+        """Mixed prefill+decode step over the FLAT ragged packing
+        (kernels/paged_attention.py ragged_paged_attention): x: [T, D]
+        — decode rows and prefill chunks packed into tile-aligned
+        segments, no batch axis. The step's k/v is scattered into the
+        pool at `slots` [T] first (pad positions land in scratch
+        block 0), then one attention launch serves every row. Returns
+        (out [T, D], (new_k_pool, new_v_pool))."""
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        t = x.shape[0]
+        if self.fused_qkv:
+            p = self.qkv(cx, x).reshape(       # head-major: [H, 3, hd]
+                t, self.num_heads, 3, self.head_dim)
+            qh, kh, vh = p[..., 0, :], p[..., 1, :], p[..., 2, :]
+        else:
+            qh = self.q_proj(cx, x).reshape(t, self.num_heads,
+                                            self.head_dim)
+            kh = self.k_proj(cx, x).reshape(t, self.num_kv_heads,
+                                            self.head_dim)
+            vh = self.v_proj(cx, x).reshape(t, self.num_kv_heads,
+                                            self.head_dim)
+        nb, bs = k_pool.shape[:2]
+        flat = (nb * bs,) + k_pool.shape[2:]
+        k_pool = k_pool.reshape(flat).at[slots].set(
+            kh.astype(k_pool.dtype)).reshape(k_pool.shape)
+        v_pool = v_pool.reshape(flat).at[slots].set(
+            vh.astype(v_pool.dtype)).reshape(v_pool.shape)
+        from paddle_tpu.kernels import paged_attention as paged
+        out = paged.ragged_paged_attention(
+            qh, k_pool, v_pool, block_tables, context_lens, q_starts,
+            tile_rows, tile_offs)                          # [T, H, hd]
+        out = self.out_proj(cx, out.reshape(t, self.model_dim))
+        return out, (k_pool, v_pool)
+
 
 class FeedForward(Module):
     def __init__(self, model_dim: int, hidden_dim: int, dropout: float = 0.1,
@@ -488,6 +524,17 @@ class CausalBlock(Module):
         x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
         return x, pools
 
+    def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
+                          block_tables, context_lens, q_starts, tile_rows,
+                          tile_offs, slots):
+        cx = cx.scope(self._name or type(self).__name__)  # see attend()
+        h, pools = self.attn.ragged_step_paged(
+            cx, self.ln1(cx, x), k_pool, v_pool, block_tables,
+            context_lens, q_starts, tile_rows, tile_offs, slots)
+        x = x + self.drop(cx, h)
+        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        return x, pools
+
 
 class CausalLM(Module):
     """Decoder-only autoregressive LM (GPT-style).
@@ -653,6 +700,36 @@ class CausalLM(Module):
         last_h = jnp.take_along_axis(
             hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)
         return self._head(cx, last_h)[:, 0], new_pools
+
+    def ragged_step_paged(self, cx: Context, tokens, positions, pools,
+                          block_tables, context_lens, q_starts, tile_rows,
+                          tile_offs, slots, last_idx):
+        """ONE mixed prefill+decode serve step over the flat ragged
+        packing — the engine's single compiled path. tokens [T] ids and
+        positions [T] int32 are the flat packing (decode rows are
+        1-token windows at position seq_len; chunk rows are
+        budget-bounded prompt windows; pad positions carry token 0 at
+        position 0 and scatter to scratch slot 0). Per-ROW metadata
+        block_tables [R, MB] / context_lens [R] / q_starts [R] and
+        per-TILE tile_rows/tile_offs [NT] follow the
+        ragged_paged_attention contract. last_idx [B] int32 gathers
+        each planned row's final real token's hidden state; returns
+        (logits [B, V], new pools) — the engine samples only the rows
+        whose window ended a prompt or decoded a token."""
+        x = self.embed(cx, tokens) * math.sqrt(self.model_dim)   # [T, D]
+        pe = sinusoid_position_encoding(self.max_len, self.model_dim)
+        pos_safe = jnp.clip(positions.astype(jnp.int32), 0, self.max_len - 1)
+        x = x + pe[pos_safe].astype(x.dtype)
+        new_pools = []
+        for blk, (k_pool, v_pool) in zip(self.blocks, pools):
+            x, np_ = blk.ragged_step_paged(cx, x, k_pool, v_pool,
+                                           block_tables, context_lens,
+                                           q_starts, tile_rows, tile_offs,
+                                           slots)
+            new_pools.append(np_)
+        hidden = self.ln_f(cx, x)                                # [T, D]
+        last_h = jnp.take(hidden, last_idx.astype(jnp.int32), axis=0)
+        return self._head(cx, last_h), new_pools
 
     def decode_step_paged(self, cx: Context, tokens, positions, pools,
                           block_tables, context_lens, slots):

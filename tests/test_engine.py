@@ -101,13 +101,14 @@ class TestScheduler:
         s = Scheduler(c, max_batch_size=2, max_prefill_tokens=8)
         for p in ([1, 2, 3], [4, 5], [6]):
             s.add(Request(prompt=list(p)))
-        kind, chunks = s.next_batch()
-        assert kind == "prefill"
-        assert [ch.length for ch in chunks] == [3, 2]    # batch cap hit
-        assert [ch.start for ch in chunks] == [0, 0]
+        rows = s.next_batch()
+        assert all(not w.decode for w in rows)
+        assert [w.length for w in rows] == [3, 2]        # batch cap hit
+        assert [w.start for w in rows] == [0, 0]
         assert s.queue_depth == 1
-        kind, reqs2 = s.next_batch()
-        assert kind == "decode" and len(reqs2) == 2      # admission full
+        rows2 = s.next_batch()
+        assert all(w.decode for w in rows2)              # admission full
+        assert [w.length for w in rows2] == [1, 1]
 
     def test_long_prompt_prefills_in_chunks(self):
         """A prompt over the per-step budget admits anyway and is cut
@@ -118,9 +119,9 @@ class TestScheduler:
         s.add(Request(prompt=list(range(20))))
         seen = []
         for _ in range(3):
-            kind, chunks = s.next_batch()
-            assert kind == "prefill" and len(chunks) == 1
-            seen.append((chunks[0].start, chunks[0].length))
+            rows = s.next_batch()
+            assert len(rows) == 1 and not rows[0].decode
+            seen.append((rows[0].start, rows[0].length))
         assert seen == [(0, 8), (8, 8), (16, 4)]
         assert not s.running[0].prefilling
 
@@ -158,6 +159,38 @@ def _sequential(model, variables, prompts, n, **req_kw):
         eng = _engine(model, variables)
         out.append(eng.generate([p], max_new_tokens=n, **req_kw)[0])
     return out
+
+
+def test_prefill_budget_validated_at_construction(model_and_vars):
+    """max_prefill_tokens is checked against the model's usable context
+    at construction: nonsense rejects, oversize clamps (and shrinks the
+    compiled step) instead of silently padding dead tiles."""
+    model, variables = model_and_vars
+    with pytest.raises(ValueError, match="max_prefill_tokens"):
+        _engine(model, variables, max_prefill_tokens=0)
+    big = _engine(model, variables, max_prefill_tokens=10_000)
+    assert big.scheduler.max_prefill_tokens == big.max_seq_len
+    assert big.flat_tokens == _engine(model, variables).flat_tokens
+
+
+def test_one_compile_for_mixed_traffic(model_and_vars):
+    """THE one-compilation claim, asserted mechanically: a serve run
+    mixing long chunked prefills, short prompts and decode — including
+    steps where chunk rows and decode rows share the launch — triggers
+    exactly ONE compilation of the step callable. (The old two-path
+    engine compiled the decode step plus one prefill step per pow2
+    bucket: O(log chunk_budget) compiles.)"""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, max_prefill_tokens=8)
+    eng.add_request([3, 1, 4], max_new_tokens=2)     # warmup
+    eng.run()
+    assert eng._step_fn._cache_size() == 1
+    eng.add_request(list(range(1, 30)), max_new_tokens=4)   # 4 chunks
+    eng.add_request([5, 9], max_new_tokens=6)               # decode rider
+    eng.add_request(list(range(30, 43)), max_new_tokens=3)  # mid-size
+    eng.run()
+    assert eng._step_fn._cache_size() == 1           # zero recompiles
+    assert eng._copy_blocks._cache_size() <= 1
 
 
 def test_batched_equals_sequential(model_and_vars, capsys):

@@ -14,8 +14,9 @@ import numpy as np
 import pytest
 
 from paddle_tpu.kernels.attention import reference_attention
-from paddle_tpu.kernels.paged_attention import (paged_attention,
-                                                paged_attention_reference)
+from paddle_tpu.kernels.paged_attention import (
+    paged_attention, paged_attention_reference, ragged_paged_attention,
+    ragged_paged_attention_reference)
 
 pytestmark = pytest.mark.serve
 
@@ -123,3 +124,142 @@ def test_kernel_grad_free_path_jits():
     got = f(q, k_pool, v_pool, tables, cl)
     want = _dense_oracle(q, k, v, cl)
     np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+# -- ragged mixed prefill+decode ------------------------------------------
+
+def _ragged_case(rows, h, hkv, d, bs, tq, seed=0, extra_pad_tiles=1):
+    """Build a flat-packed mixed batch. `rows` is a list of
+    (context_len, q_len): each row's queries are the window
+    [ctx - q_len, ctx) of its sequence — q_len=1 is a decode row,
+    q_len=ctx a whole prompt, anything between a mid-prompt chunk.
+    Returns the ragged operands plus the dense k/v and per-row dense
+    queries for the oracle."""
+    b = len(rows)
+    tmax = max(ctx for ctx, _ in rows)
+    rng = np.random.default_rng(seed)
+    k = jnp.asarray(rng.standard_normal((b, tmax, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, tmax, hkv, d)), jnp.float32)
+    k_pool, v_pool, tables = _pools_from_dense(k, v, bs)
+    mb = tables.shape[1]
+    nt = sum(-(-qlen // tq) for _, qlen in rows) + extra_pad_tiles
+    t_flat = nt * tq
+    qflat = np.zeros((t_flat, h, d), np.float32)
+    tile_rows = np.full((nt,), b, np.int32)      # default: null row
+    tile_offs = np.zeros((nt,), np.int32)
+    bt = np.zeros((b + 1, mb), np.int32)
+    bt[:b] = np.asarray(tables)
+    cl = np.ones((b + 1,), np.int32)
+    qs = np.zeros((b + 1,), np.int32)
+    qrows, spans = [], []
+    cursor = 0
+    for i, (ctx, qlen) in enumerate(rows):
+        cl[i], qs[i] = ctx, ctx - qlen
+        qi = rng.standard_normal((qlen, h, d)).astype(np.float32)
+        qrows.append(qi)
+        qflat[cursor:cursor + qlen] = qi
+        spans.append((cursor, qlen))
+        for t in range(-(-qlen // tq)):
+            tile_rows[cursor // tq + t] = i
+            tile_offs[cursor // tq + t] = t * tq
+        cursor += -(-qlen // tq) * tq
+    args = (jnp.asarray(qflat), k_pool, v_pool, jnp.asarray(bt),
+            jnp.asarray(cl), jnp.asarray(qs), jnp.asarray(tile_rows),
+            jnp.asarray(tile_offs))
+    return args, k, v, qrows, spans
+
+
+def _ragged_dense_oracle(k, v, qrows, rows):
+    """Per-row causal dense attention over the same tokens: query at
+    absolute position p attends k[:p+1]."""
+    outs = []
+    for i, (ctx, qlen) in enumerate(rows):
+        qi = jnp.asarray(qrows[i])[None]             # [1, C, H, D]
+        kv_pos = jnp.arange(k.shape[1])
+        qpos = jnp.arange(ctx - qlen, ctx)
+        mask = ((kv_pos[None, :] <= qpos[:, None])
+                & (kv_pos[None, :] < ctx))[None, None]
+        outs.append(reference_attention(qi, k[i:i + 1], v[i:i + 1],
+                                        mask=mask)[0])
+    return outs
+
+
+RAGGED_MIXED_CASES = [
+    # (rows [(ctx, qlen)], H, Hkv, D, block_size, tile_q)
+    ([(5, 1), (8, 1), (1, 1)], 4, 4, 8, 4, 4),        # all decode rows
+    ([(7, 1), (10, 6), (4, 4)], 4, 4, 8, 4, 4),       # decode + chunks
+    ([(9, 9), (13, 5), (6, 1)], 4, 4, 8, 4, 4),       # whole-prompt + mid
+    ([(7, 3), (11, 1)], 8, 2, 16, 4, 4),              # GQA 4:1
+    ([(12, 5), (3, 1)], 4, 1, 8, 8, 4),               # MQA
+    ([(16, 16)], 4, 4, 8, 4, 8),                      # block-aligned, tq 8
+]
+
+
+@pytest.mark.parametrize("rows,h,hkv,d,bs,tq", RAGGED_MIXED_CASES)
+def test_ragged_reference_matches_dense(rows, h, hkv, d, bs, tq):
+    args, k, v, qrows, spans = _ragged_case(rows, h, hkv, d, bs, tq)
+    got = ragged_paged_attention_reference(*args)
+    for i, (off, qlen) in enumerate(spans):
+        want = _ragged_dense_oracle(k, v, qrows, rows)[i]
+        np.testing.assert_allclose(got[off:off + qlen], want,
+                                   atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("rows,h,hkv,d,bs,tq", RAGGED_MIXED_CASES)
+def test_ragged_kernel_matches_reference(rows, h, hkv, d, bs, tq):
+    """The ragged Pallas kernel in interpret mode vs the XLA oracle on
+    mixed batches — decode rows, mid-prompt chunks, pad slack and GQA
+    head groups in one launch."""
+    args, k, v, qrows, spans = _ragged_case(rows, h, hkv, d, bs, tq)
+    got = ragged_paged_attention(*args, use_kernel=True, interpret=True)
+    want = ragged_paged_attention_reference(*args)
+    assert bool(jnp.isfinite(got).all())    # pad queries/tiles stay finite
+    for off, qlen in spans:
+        np.testing.assert_allclose(got[off:off + qlen],
+                                   want[off:off + qlen],
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_ragged_decode_rows_match_decode_kernel():
+    """A decode row in the ragged layout is EXACTLY the old decode
+    kernel's contract (q_start = ctx - 1): outputs must agree with
+    paged_attention on the same pools."""
+    rows = [(5, 1), (8, 1), (3, 1)]
+    args, k, v, qrows, spans = _ragged_case(rows, 4, 4, 8, 4, 4)
+    qflat, k_pool, v_pool, bt, cl, qs, tr, to = args
+    got = ragged_paged_attention_reference(*args)
+    qb = jnp.stack([qrows[i][0] for i in range(3)])    # [B, H, D]
+    want = paged_attention_reference(qb, k_pool, v_pool, bt[:3], cl[:3])
+    for i, (off, _) in enumerate(spans):
+        np.testing.assert_allclose(got[off], want[i], atol=1e-6, rtol=1e-6)
+
+
+def test_ragged_pad_rows_are_inert():
+    """Pad tiles (null metadata row) and within-segment pad queries
+    must not perturb real rows: packing the same rows with extra pad
+    tiles yields bit-identical real segments."""
+    rows = [(7, 1), (10, 6)]
+    a1, *_ , spans1 = _ragged_case(rows, 4, 4, 8, 4, 4, extra_pad_tiles=1)
+    a2, *_ , spans2 = _ragged_case(rows, 4, 4, 8, 4, 4, extra_pad_tiles=3)
+    g1 = ragged_paged_attention(*a1, use_kernel=True, interpret=True)
+    g2 = ragged_paged_attention(*a2, use_kernel=True, interpret=True)
+    for (o1, n1), (o2, n2) in zip(spans1, spans2):
+        np.testing.assert_allclose(g1[o1:o1 + n1], g2[o2:o2 + n2],
+                                   atol=0, rtol=0)
+
+
+def test_env_override_dispatch(monkeypatch):
+    """PTPU_PAGED_KERNEL forces the tier when callers use defaults;
+    explicit flags still win."""
+    rows = [(5, 1), (9, 4)]
+    args, *_ = _ragged_case(rows, 4, 4, 8, 4, 4)
+    ref = ragged_paged_attention_reference(*args)
+    monkeypatch.setenv("PTPU_PAGED_KERNEL", "interpret")
+    got = ragged_paged_attention(*args)      # defaults -> kernel interpret
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    monkeypatch.setenv("PTPU_PAGED_KERNEL", "reference")
+    got = ragged_paged_attention(*args)
+    np.testing.assert_allclose(got, ref, atol=0, rtol=0)
+    monkeypatch.setenv("PTPU_PAGED_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="PTPU_PAGED_KERNEL"):
+        ragged_paged_attention(*args)

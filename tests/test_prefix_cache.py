@@ -184,10 +184,10 @@ def test_plan_drops_chunk_of_request_preempted_mid_plan():
     # admission: b revives the prefix + 1 fresh block (pool now empty),
     # cx rides the shared prefix; cx's capped last token needs a COW,
     # starves, and evicts b — whose chunk was already planned
-    kind, chunks = sched.next_batch()
-    assert kind == "prefill"
-    assert [ch.req for ch in chunks] == [cx]
-    assert all(ch.req in sched.running for ch in chunks)
+    rows = sched.next_batch()
+    assert all(not w.decode for w in rows)
+    assert [w.req for w in rows] == [cx]
+    assert all(w.req in sched.running for w in rows)
     assert b in sched.waiting and b.state == "waiting"
     assert b.prefill_pos == 0
 

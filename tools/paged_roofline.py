@@ -1,0 +1,192 @@
+"""Paged-KV roofline: size the block pool against HBM, bound decode.
+
+Sweeps (block_size x num_blocks) cells and reports, per cell:
+
+- pool_gb:    KV pool footprint = layers * 2 * NB * BS * Hkv * Dh * 2B
+              (bf16 K and V planes per layer), and the fraction of the
+              rig's HBM it claims (--hbm-gb).
+- capacity:   tokens the pool can hold (NB * BS) and the context each
+              of --batch concurrent decodes gets at full occupancy.
+- decode bytes/token: a decode step streams every live block of the
+              row's context once (the ragged kernel's skip predicate
+              elides only past-context blocks, so partial tail blocks
+              still stream whole): layers * 2 * ceil(ctx/BS) * BS *
+              Hkv * Dh * 2B. Arithmetic intensity of paged decode is
+              ~1 FLOP/byte, far left of the ridge, so the HBM ceiling
+              IS the decode ceiling:
+- tok_s_ceiling: --hbm-gbps / bytes_per_token — the best any kernel
+              can do at that context length on this rig.
+
+Default run is a CPU smoke: prints the analytic sweep and validates the
+ragged kernel end-to-end in interpret mode on one tiny cell (finite
+output, matches the XLA reference). `--rig` additionally times the
+real kernel per cell on the TPU (run_timed two-window subtraction,
+state-chained so the axon pool cannot parallelize) and reports achieved
+GB/s against --hbm-gbps.
+
+Run: python tools/paged_roofline.py [--rig] [--block-sizes 8,16,32]
+     [--num-blocks 512,2048,8192] [--hbm-gb 16 --hbm-gbps 819]
+"""
+
+import argparse
+import sys
+
+import _bootstrap  # noqa: F401  (repo path + cpu override)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def kv_pool_bytes(layers, num_blocks, block_size, kv_heads, head_dim,
+                  dtype_bytes=2):
+    return layers * 2 * num_blocks * block_size * kv_heads * head_dim \
+        * dtype_bytes
+
+
+def decode_bytes_per_token(layers, ctx, block_size, kv_heads, head_dim,
+                           dtype_bytes=2):
+    blocks = -(-ctx // block_size)
+    return layers * 2 * blocks * block_size * kv_heads * head_dim \
+        * dtype_bytes
+
+
+def _ragged_decode_operands(batch, ctx, block_size, num_blocks, heads,
+                            kv_heads, head_dim, tile_q=8, seed=0):
+    """Flat-packed pure-decode batch: one tile per row, query at the
+    last written position, distinct blocks per row."""
+    rs = np.random.RandomState(seed)
+    mb = -(-ctx // block_size)
+    assert batch * mb <= num_blocks, "pool too small for the sweep cell"
+    t_flat = batch * tile_q
+    q = jnp.asarray(rs.randn(t_flat, heads, head_dim), jnp.float32) * 0.3
+    k_pool = jnp.asarray(
+        rs.randn(num_blocks, block_size, kv_heads, head_dim),
+        jnp.float32) * 0.3
+    v_pool = jnp.asarray(
+        rs.randn(num_blocks, block_size, kv_heads, head_dim),
+        jnp.float32) * 0.3
+    perm = rs.permutation(num_blocks)
+    bt = np.zeros((batch + 1, mb), np.int32)
+    for i in range(batch):
+        bt[i] = perm[i * mb:(i + 1) * mb]
+    cl = np.full((batch + 1,), ctx, np.int32)
+    cl[batch] = 1                               # null row contract
+    qs = np.full((batch + 1,), ctx - 1, np.int32)
+    qs[batch] = 0
+    tr = np.arange(batch, dtype=np.int32)       # one tile per row
+    to = np.zeros((batch,), np.int32)
+    return (q, k_pool, v_pool, jnp.asarray(bt), jnp.asarray(cl),
+            jnp.asarray(qs), jnp.asarray(tr), jnp.asarray(to))
+
+
+def smoke_interpret():
+    """Tiny end-to-end validation: interpret-mode kernel vs reference."""
+    from paddle_tpu.kernels import paged_attention as paged
+
+    ops = _ragged_decode_operands(batch=2, ctx=10, block_size=4,
+                                  num_blocks=16, heads=4, kv_heads=2,
+                                  head_dim=8)
+    ref = paged.ragged_paged_attention(*ops, use_kernel=False)
+    out = paged.ragged_paged_attention(*ops, use_kernel=True,
+                                       interpret=True)
+    diff = float(jnp.max(jnp.abs(out - ref)))
+    ok = bool(np.isfinite(diff) and diff < 1e-5)
+    print(f"interpret smoke: kernel vs reference max|diff| = {diff:.2e} "
+          f"-> {'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def measure_cell(batch, ctx, block_size, num_blocks, heads, kv_heads,
+                 head_dim, tile_q=8):
+    """Time one ragged decode launch on the rig; returns (ms, GB/s)."""
+    from paddle_tpu.benchmark.harness import run_timed
+    from paddle_tpu.kernels import paged_attention as paged
+
+    ops = _ragged_decode_operands(batch, ctx, block_size, num_blocks,
+                                  heads, kv_heads, head_dim, tile_q)
+    q = ops[0]
+
+    def step(c):
+        out = paged.ragged_paged_attention(q + c.astype(q.dtype), *ops[1:])
+        return (jnp.sum(out.astype(jnp.float32)) * 1e-30
+                ).astype(jnp.float32)
+
+    f = jax.jit(step)
+
+    def once(s):
+        out = f(s)
+        return out, out
+
+    sec, _, _ = run_timed(once, jnp.zeros((), jnp.float32), min_time=1.0)
+    # one attention layer's streamed bytes (fp32 operands here: 4B)
+    streamed = batch * decode_bytes_per_token(1, ctx, block_size,
+                                              kv_heads, head_dim,
+                                              dtype_bytes=4)
+    return sec * 1e3, streamed / sec / 1e9
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--block-sizes", default="8,16,32")
+    ap.add_argument("--num-blocks", default="512,2048,8192")
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=8,
+                    help="concurrent decode rows at full occupancy")
+    ap.add_argument("--hbm-gb", type=float, default=16.0)
+    ap.add_argument("--hbm-gbps", type=float, default=819.0,
+                    help="rig HBM bandwidth (v5e datasheet: 819 GB/s)")
+    ap.add_argument("--rig", action="store_true",
+                    help="time the real kernel on the TPU per cell")
+    args = ap.parse_args()
+
+    if args.rig:
+        assert jax.devices()[0].platform == "tpu", "--rig needs the TPU"
+
+    block_sizes = [int(s) for s in args.block_sizes.split(",")]
+    num_blocks = [int(s) for s in args.num_blocks.split(",")]
+    L, Hkv, Dh = args.layers, args.kv_heads, args.head_dim
+
+    print(f"model: {L} layers, {args.heads} heads ({Hkv} kv), "
+          f"head_dim {Dh}, bf16 pool; rig: {args.hbm_gb:.0f} GB HBM "
+          f"@ {args.hbm_gbps:.0f} GB/s; batch {args.batch}")
+    hdr = (f"{'BS':>4} {'NB':>6} {'pool_gb':>8} {'%hbm':>6} "
+           f"{'cap_tok':>8} {'ctx/row':>8} {'KB/tok':>8} "
+           f"{'tok_s_ceil':>10}")
+    if args.rig:
+        hdr += f" {'kern_ms':>8} {'GB/s':>7} {'%bw':>5}"
+    print(hdr)
+
+    ok = True
+    for bs in block_sizes:
+        for nb in num_blocks:
+            pool = kv_pool_bytes(L, nb, bs, Hkv, Dh)
+            cap = nb * bs
+            ctx = (nb // args.batch) * bs       # full-occupancy context
+            bpt = decode_bytes_per_token(L, ctx, bs, Hkv, Dh)
+            ceil_tok = args.hbm_gbps * 1e9 / bpt
+            frac = pool / (args.hbm_gb * 1e9)
+            line = (f"{bs:>4} {nb:>6} {pool/1e9:>8.3f} {frac*100:>5.1f}% "
+                    f"{cap:>8} {ctx:>8} {bpt/1e3:>8.1f} "
+                    f"{ceil_tok:>10.0f}")
+            if frac > 1.0:
+                line += "  (exceeds HBM -- skipped)"
+                print(line)
+                continue
+            if args.rig:
+                ms, gbs = measure_cell(args.batch, ctx, bs, nb,
+                                       args.heads, Hkv, Dh)
+                line += (f" {ms:>8.3f} {gbs:>7.1f} "
+                         f"{gbs/args.hbm_gbps*100:>4.1f}%")
+            print(line)
+
+    if not args.rig:
+        ok = smoke_interpret()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

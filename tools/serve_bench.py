@@ -1,6 +1,6 @@
 """Serving microbench: batching, prefix sharing, chunked prefill.
 
-Three scenarios, each an acceptance property of the engine subsystem
+Four scenarios, each an acceptance property of the engine subsystem
 (ENGINE.md), each verified on the SAME model with EXACT token identity
 (greedy decode — the engine's batching/sharing/chunking invariance
 makes identity, not closeness, the bar):
@@ -15,6 +15,12 @@ makes identity, not closeness, the bar):
            bound the worst-case step latency below the monolithic
            prefill's (inter-token latency of concurrent decodes stays
            bounded), at identical outputs.
+- mixed:   mixed prefill+decode traffic through the unified ragged
+           step must trigger ZERO recompiles after the first warmup
+           step (every step shares one flat-packed compiled shape —
+           counted via the jit cache), while keeping the chunked
+           worst-case step bound and exact token identity vs the
+           monolithic-budget engine.
 
 One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 (flushed — a harness timeout still sees every completed cell):
@@ -93,8 +99,8 @@ def scenario_batch(model, variables, args):
     for batched in (False, True):
         eng = make_engine(model, variables, args,
                           max_batch_size=args.batch if batched else 1)
-        # warmup on THIS engine: compile prefill bucket + decode step
-        # outside the timed window so both modes measure steady state
+        # warmup on THIS engine: compile the unified step outside the
+        # timed window so both modes measure steady state
         eng.generate([prompts[0]], max_new_tokens=2)
         t0 = time.perf_counter()
         if batched:
@@ -134,13 +140,15 @@ def scenario_prefix(model, variables, args):
 
     results = {}
     for enabled in (False, True):
+        # chunk budget < prompt: the unified ragged step costs the same
+        # flat width every launch, so prefix hits buy TTFT by skipping
+        # whole chunk STEPS, not by shrinking a step
         eng = make_engine(model, variables, args,
-                          enable_prefix_cache=enabled)
-        # compile the full-prompt bucket, the suffix bucket (via a
-        # same-prefix warmup hit), and the decode step, untimed
+                          enable_prefix_cache=enabled,
+                          max_prefill_tokens=args.chunk_tokens)
+        # compile the single unified step untimed (one shape serves
+        # every chunk/decode mix)
         eng.generate([warm_long], max_new_tokens=2)
-        eng.generate([warm_long[:-1] + [args.vocab - 2]],
-                     max_new_tokens=2)
         eng.reset_stats()
         outs, mean_ttft, wall = serve_turns(eng, prompts, args.new_tokens)
         stats = eng.stats()
@@ -225,10 +233,73 @@ def scenario_chunked(model, variables, args):
     return ok
 
 
+# -- scenario: mixed traffic, one compiled step ----------------------------
+
+def _run_mixed_cell(model, variables, args, budget):
+    """Two short requests decoding while two long prompts (different
+    lengths — the pow2-bucket killer) stream in mid-serve. Counts jit
+    step compiles across the post-warmup traffic."""
+    eng = make_engine(model, variables, args, max_prefill_tokens=budget)
+    warm = [args.vocab - 1] * 4
+    eng.generate([warm], max_new_tokens=2)          # compile untimed
+    eng.reset_stats()
+    compiles_before = eng._step_fn._cache_size()
+
+    rng = np.random.default_rng(3)
+    shorts = [rng.integers(0, args.vocab - 1, 4).tolist()
+              for _ in range(2)]
+    longs = [rng.integers(0, args.vocab - 1, n).tolist()
+             for n in (args.system_len, args.system_len // 2 + 3)]
+    rs = [eng.add_request(p, max_new_tokens=args.new_tokens)
+          for p in shorts]
+    for _ in range(2):                              # shorts reach decode
+        eng.step()
+    rl = [eng.add_request(p, max_new_tokens=4) for p in longs]
+    step_times = []
+    while True:
+        t0 = time.perf_counter()
+        if not eng.step():
+            break
+        step_times.append(time.perf_counter() - t0)
+    outs = [eng._generated_of(r) for r in rs + rl]
+    recompiles = eng._step_fn._cache_size() - compiles_before
+    return {"cell": f"mixed_budget_{budget}",
+            "recompiles": int(recompiles),
+            "step_compiles_total": int(eng._step_fn._cache_size()),
+            "max_step_ms": round(max(step_times) * 1e3, 3),
+            "mean_step_ms": round(float(np.mean(step_times)) * 1e3, 3),
+            "steps": len(step_times),
+            "max_chunk_tokens": eng.max_chunk_tokens}, outs
+
+
+def scenario_mixed(model, variables, args):
+    mono, mono_outs = _run_mixed_cell(model, variables, args,
+                                      budget=args.max_len)
+    emit(mono)
+    mixed, mixed_outs = _run_mixed_cell(model, variables, args,
+                                        budget=args.chunk_tokens)
+    emit(mixed)
+    identical = mixed_outs == mono_outs
+    ok = bool(identical
+              and mixed["recompiles"] == 0
+              and mixed["step_compiles_total"] == 1
+              and mixed["max_step_ms"] < mono["max_step_ms"])
+    emit({"cell": "mixed_verdict", "ok": ok,
+          "tokens_identical": bool(identical),
+          "recompiles": mixed["recompiles"],
+          "one_compiled_step":
+              bool(mixed["step_compiles_total"] == 1),
+          "max_step_speedup": round(mono["max_step_ms"]
+                                    / max(mixed["max_step_ms"], 1e-9),
+                                    2)})
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
-                    choices=["all", "batch", "prefix", "chunked"])
+                    choices=["all", "batch", "prefix", "chunked",
+                             "mixed"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -246,7 +317,7 @@ def main():
 
     model, variables = build_model(args)
     scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
-                 "chunked": scenario_chunked}
+                 "chunked": scenario_chunked, "mixed": scenario_mixed}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
