@@ -54,6 +54,8 @@ import numpy as np
 from paddle_tpu.core.module import Context, _CtxCore
 from paddle_tpu.engine.paged_cache import PagedKVCache
 from paddle_tpu.engine.scheduler import Request, Scheduler, StepRow
+from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
+from paddle_tpu.obs.tracing import RequestTracer
 from paddle_tpu.utils.log import serve_event
 
 _COPY_LANES = 8     # COW copies flushed through one fixed-shape call
@@ -126,9 +128,16 @@ class ServeEngine:
                  max_seq_len: Optional[int] = None,
                  max_prefill_tokens: int = 512,
                  tile_q: int = 8,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[RequestTracer] = None):
         self.model = model
         self.variables = variables
+        # telemetry (OBSERVABILITY.md): None -> the process registry /
+        # a fresh tracer. serve_bench passes a private registry per
+        # engine so its A/B cells don't pollute each other.
+        self.obs = registry if registry is not None else default_registry()
+        self.tracer = tracer if tracer is not None else RequestTracer()
         attn = model.blocks[0].attn
         self.max_seq_len = min(max_seq_len or model.max_len, model.max_len)
         self.max_batch_size = max_batch_size
@@ -157,18 +166,20 @@ class ServeEngine:
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
             head_dim=attn.head_dim, dtype=model.dtype,
-            enable_prefix_cache=enable_prefix_cache)
+            enable_prefix_cache=enable_prefix_cache, registry=self.obs)
         self.max_blocks_per_seq = self.cache.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(
             self.cache, max_batch_size=max_batch_size,
             max_prefill_tokens=max_prefill_tokens,
             max_seq_len=self.max_seq_len - 1)  # leave room for >=1 new token
         self.scheduler.on_preempt = self._on_preempt
+        self.scheduler.on_admit = self._on_admit
         self.finished: Dict[int, Request] = {}
         self.steps = 0
         self.prefill_tokens_computed = 0
         self.peak_occupancy = 0.0
         self.max_chunk_tokens = 0       # largest prefill step actually run
+        self._register_metrics()
 
         model_ = model
 
@@ -221,6 +232,76 @@ class ServeEngine:
         engine_kwargs.setdefault("max_seq_len", meta["max_len"])
         return cls(model, variables, **engine_kwargs)
 
+    # -- telemetry --------------------------------------------------------
+    def _register_metrics(self) -> None:
+        """Metric families this engine records (OBSERVABILITY.md has
+        the catalog). Families are get-or-create: engines sharing a
+        registry share series. Everything here is host-side bookkeeping
+        — instrumentation can never add a compile or device sync."""
+        m = self.obs
+        self._m_ttft = m.histogram(
+            "ptpu_serve_ttft_ms", "Enqueue to first token (ms)")
+        self._m_tpot = m.histogram(
+            "ptpu_serve_tpot_ms",
+            "Per-request mean decode latency per output token (ms)")
+        self._m_queue_wait = m.histogram(
+            "ptpu_serve_queue_wait_ms", "Enqueue to first admission (ms)")
+        self._m_e2e = m.histogram(
+            "ptpu_serve_e2e_ms", "Enqueue to finish (ms)")
+        self._m_step = m.histogram(
+            "ptpu_serve_step_ms", "Engine step wall time (ms)",
+            labelnames=("kind",))        # kind=decode|prefill|mixed
+        self._m_reqs = m.counter(
+            "ptpu_serve_requests_total", "Finished requests",
+            labelnames=("reason",))      # reason=eos|length
+        self._m_tokens = m.counter(
+            "ptpu_serve_tokens_total", "Token flow through the engine",
+            labelnames=("kind",))        # kind=prefill|cached|generated
+        self._m_steps = m.counter(
+            "ptpu_engine_steps_total", "Compiled mixed steps executed")
+        self._m_compiles = m.gauge(
+            "ptpu_engine_compiles",
+            "jit cache size of the unified step (the one-compile "
+            "invariant: stays at 1 across arbitrary traffic)")
+        self._m_occ = m.gauge(
+            "ptpu_kv_occupancy", "Fraction of allocatable blocks in use")
+        self._m_hit = m.gauge(
+            "ptpu_kv_hit_rate",
+            "Cumulative fraction of prompt tokens served from the "
+            "prefix cache")
+        self._m_shared = m.gauge(
+            "ptpu_kv_shared_blocks", "Blocks with refcount > 1")
+        self._m_queue_depth = m.gauge(
+            "ptpu_sched_queue_depth", "Requests waiting for admission")
+        self._m_running = m.gauge(
+            "ptpu_sched_running", "Requests in the running set")
+        self._m_decode_rows = m.gauge(
+            "ptpu_sched_decode_rows", "Decode rows in the last step")
+        self._m_prefill_rows = m.gauge(
+            "ptpu_sched_prefill_rows", "Prefill chunks in the last step")
+        self._m_budget_util = m.gauge(
+            "ptpu_sched_chunk_budget_util",
+            "Chunk tokens / max_prefill_tokens of the last "
+            "prefill-bearing step")
+        self._m_preempts = m.counter(
+            "ptpu_sched_preemptions_total", "Recompute preemptions")
+
+    def _on_admit(self, req: Request) -> None:
+        """Scheduler hook: a request left the wait queue. Queue-wait is
+        observed only on FIRST admission (a preemption re-admission is
+        a scheduling artifact, not arrival latency)."""
+        now = time.monotonic()
+        if req.admit_time == 0.0:
+            self._m_queue_wait.observe((now - req.enqueue_time) * 1e3)
+        req.admit_time = now
+        self.tracer.on_admit(req.req_id)
+        self._m_queue_depth.set(self.scheduler.queue_depth)
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition of this engine's registry (the
+        /metrics body when no scrape server is mounted)."""
+        return self.obs.render_prometheus()
+
     # -- intake -----------------------------------------------------------
     def add_request(self, prompt: List[int], max_new_tokens: int = 32,
                     temperature: float = 0.0, top_k: int = 0, seed: int = 0,
@@ -242,6 +323,8 @@ class ServeEngine:
                       eos_id=eos_id, callback=callback)
         req.enqueue_time = time.monotonic()
         self.scheduler.add(req)
+        self.tracer.on_enqueue(req.req_id)
+        self._m_queue_depth.set(self.scheduler.queue_depth)
         serve_event("serve_admit", req_id=req.req_id,
                     prompt_len=len(prompt),
                     queue_depth=self.scheduler.queue_depth)
@@ -251,13 +334,31 @@ class ServeEngine:
     def step(self) -> bool:
         """Advance one scheduler plan (one mixed batch through the
         single compiled step). Returns False when idle."""
+        t0 = time.perf_counter()
         rows = self.scheduler.next_batch()
         if rows is None:
             return False
         self.steps += 1
-        self._step_mixed(rows)
+        n_chunks, n_decodes, chunk_tokens = self._step_mixed(rows)
         self.peak_occupancy = max(self.peak_occupancy,
                                   self.cache.occupancy())
+        # per-step telemetry: host-side gauge/histogram writes only
+        kind = ("mixed" if n_chunks and n_decodes
+                else "prefill" if n_chunks else "decode")
+        self._m_step.labels(kind=kind).observe(
+            (time.perf_counter() - t0) * 1e3)
+        self._m_steps.inc()
+        self._m_compiles.set(self._step_fn._cache_size())
+        self._m_occ.set(self.cache.occupancy())
+        self._m_hit.set(self.cache.hit_rate())
+        self._m_shared.set(self.cache.shared_blocks)
+        self._m_queue_depth.set(self.scheduler.queue_depth)
+        self._m_running.set(len(self.scheduler.running))
+        self._m_decode_rows.set(n_decodes)
+        self._m_prefill_rows.set(n_chunks)
+        if n_chunks:
+            self._m_budget_util.set(
+                chunk_tokens / self.scheduler.max_prefill_tokens)
         return True
 
     def run(self) -> Dict[int, List[int]]:
@@ -282,7 +383,8 @@ class ServeEngine:
             self.cache.pools = self._copy_blocks(
                 self.cache.pools, jnp.asarray(src), jnp.asarray(dst))
 
-    def _step_mixed(self, rows: List[StepRow]) -> None:
+    def _step_mixed(self, rows: List[StepRow]
+                    ) -> "tuple[int, int, int]":
         """Pack the plan's rows — decode rows AND prefill chunks — into
         the flat ragged layout and run ONE compiled step. Row i's token
         window [start, start+length) lands in a tile_q-aligned segment
@@ -348,10 +450,12 @@ class ServeEngine:
                 self._emit_token(r, tok)
             else:
                 self.cache.commit_prefill(r.req_id, row.start + row.length)
+                self.tracer.on_chunk(r.req_id, row.start, row.length)
                 if row.start + row.length == len(r.prompt):  # final chunk
                     tok = _sample(logits[i], r, len(r.prompt))
                     if not r.first_token_time:
                         r.first_token_time = now
+                    self.tracer.on_first_token(r.req_id)
                     self._emit_token(r, tok)
         if chunks:
             # per-event field: a request's prefix-hit tokens are
@@ -363,6 +467,9 @@ class ServeEngine:
                          if w.start == w.req.cached_tokens)
             self.prefill_tokens_computed += computed
             self.max_chunk_tokens = max(self.max_chunk_tokens, computed)
+            self._m_tokens.labels(kind="prefill").inc(computed)
+            if cached:
+                self._m_tokens.labels(kind="cached").inc(cached)
             serve_event("serve_prefill", batch=len(chunks),
                         flat_t=t_flat, tokens=computed, cached=cached,
                         step=self.steps, cow=self.cache.cow_copies,
@@ -375,9 +482,11 @@ class ServeEngine:
                         step=self.steps,
                         occupancy=round(self.cache.occupancy(), 4),
                         queue_depth=self.scheduler.queue_depth)
+        return len(chunks), len(decodes), computed
 
     def _emit_token(self, req: Request, tok: int) -> None:
         req.generated.append(tok)
+        self._m_tokens.labels(kind="generated").inc()
         if req.callback is not None:
             req.callback(tok)
         hit_eos = req.eos_id is not None and tok == req.eos_id
@@ -392,6 +501,15 @@ class ServeEngine:
         ttft_ms = (req.first_token_time - req.enqueue_time) * 1e3
         decode_s = max(req.finish_time - req.first_token_time, 1e-9)
         n_gen = req.num_generated
+        # per-request latency accounting: the histograms every SLO /
+        # serve_bench verdict reads (TPOT only for requests that
+        # actually decoded past the first token)
+        self._m_ttft.observe(ttft_ms)
+        self._m_e2e.observe((req.finish_time - req.enqueue_time) * 1e3)
+        if n_gen > 1:
+            self._m_tpot.observe(decode_s * 1e3 / (n_gen - 1))
+        self._m_reqs.labels(reason=reason).inc()
+        self.tracer.on_finish(req.req_id, reason)
         serve_event("serve_done", req_id=req.req_id, reason=reason,
                     tokens=n_gen, ttft_ms=round(ttft_ms, 3),
                     decode_tok_s=round(max(n_gen - 1, 0) / decode_s, 2),
@@ -399,6 +517,8 @@ class ServeEngine:
                     preemptions=req.preemptions)
 
     def _on_preempt(self, req: Request) -> None:
+        self._m_preempts.inc()
+        self.tracer.on_preempt(req.req_id)
         serve_event("serve_preempt", req_id=req.req_id,
                     kept_tokens=len(req.prompt),
                     occupancy=round(self.cache.occupancy(), 4))
@@ -419,12 +539,17 @@ class ServeEngine:
 
     def reset_stats(self) -> None:
         """Zero the cumulative counters (after a warmup drain) without
-        touching compiled steps or live state."""
+        touching compiled steps or live state. Also zeroes this
+        engine's metrics registry IN PLACE (families and child handles
+        survive) and the request tracer — the post-warmup baseline
+        serve_bench measures from."""
         self.cache.reset_stats()
         self.prefill_tokens_computed = 0
         self.peak_occupancy = 0.0
         self.max_chunk_tokens = 0
         self.steps = 0
+        self.obs.reset()
+        self.tracer.reset()
 
     # -- convenience --------------------------------------------------------
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,

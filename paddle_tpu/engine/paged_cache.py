@@ -51,9 +51,11 @@ sequence.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+
+from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
 
 
 class CacheExhausted(Exception):
@@ -70,7 +72,8 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 enable_prefix_cache: bool = True):
+                 enable_prefix_cache: bool = True,
+                 registry: Optional[MetricsRegistry] = None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
         self.num_blocks = num_blocks
@@ -105,6 +108,25 @@ class PagedKVCache:
         self.hit_tokens = 0
         self.prompt_tokens = 0
         self.cow_copies = 0
+        self.cached_free_evictions = 0    # stale prefix entries recycled
+        self.cached_free_revivals = 0     # freed blocks re-hit from the index
+        # event-driven counters into the metrics registry
+        # (OBSERVABILITY.md); gauges (occupancy/hit_rate) are sampled
+        # per step by the engine — nothing here runs per token
+        reg = registry if registry is not None else default_registry()
+        self._c_cow = reg.counter(
+            "ptpu_kv_cow_copies_total", "Copy-on-write block copies")
+        self._c_evict = reg.counter(
+            "ptpu_kv_cached_free_evictions_total",
+            "Cached-free prefix entries evicted on block reuse")
+        self._c_revive = reg.counter(
+            "ptpu_kv_cached_free_revivals_total",
+            "Freed blocks revived from the prefix index")
+        self._c_prompt_toks = reg.counter(
+            "ptpu_kv_prompt_tokens_total", "Prompt tokens admitted")
+        self._c_hit_toks = reg.counter(
+            "ptpu_kv_hit_tokens_total",
+            "Prompt tokens served from the prefix cache")
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -144,6 +166,8 @@ class PagedKVCache:
         key = self._key_of.pop(block, None)
         if key is not None and self._index.get(key) == block:
             del self._index[key]
+            self.cached_free_evictions += 1
+            self._c_evict.inc()
         return block
 
     def _match_prefix(self, tokens: Sequence[int]) -> List[int]:
@@ -201,6 +225,8 @@ class PagedKVCache:
             else:                       # cached-free hit: revive the block
                 self._free.remove(b)
                 self._refs[b] = 1
+                self.cached_free_revivals += 1
+                self._c_revive.inc()
         fresh = [self._pop_free() for _ in range(need)]
         for b in fresh:
             self._refs[b] = 1
@@ -212,6 +238,8 @@ class PagedKVCache:
         if count_stats:
             self.hit_tokens += cached
             self.prompt_tokens += n
+            self._c_hit_toks.inc(cached)
+            self._c_prompt_toks.inc(n)
         return cached
 
     def ensure_writable(self, seq_id: int, start: int, end: int) -> None:
@@ -235,6 +263,7 @@ class PagedKVCache:
             table[bi] = new
             self._pending_copies.append((old, new))
             self.cow_copies += 1
+            self._c_cow.inc()
 
     def drain_copies(self) -> List[Tuple[int, int]]:
         """Queued COW block copies; the engine MUST replay them on the
@@ -360,6 +389,8 @@ class PagedKVCache:
             "prompt_tokens": self.prompt_tokens,
             "hit_rate": round(self.hit_rate(), 4),
             "cow_copies": self.cow_copies,
+            "cached_free_evictions": self.cached_free_evictions,
+            "cached_free_revivals": self.cached_free_revivals,
             "shared_blocks": self.shared_blocks,
             "used_blocks": self.used_blocks,
             "occupancy": round(self.occupancy(), 4),
@@ -367,6 +398,7 @@ class PagedKVCache:
 
     def reset_stats(self) -> None:
         self.hit_tokens = self.prompt_tokens = self.cow_copies = 0
+        self.cached_free_evictions = self.cached_free_revivals = 0
 
     def assert_quiesced(self) -> None:
         """Leak check: with no live sequences every refcount must be

@@ -74,6 +74,7 @@ class Request:
     prefill_pos: int = 0              # prompt tokens prefilled (or cached)
     cached_tokens: int = 0            # prefix-cache hit at last admission
     enqueue_time: float = 0.0
+    admit_time: float = 0.0           # first admission (queue-wait metric)
     first_token_time: float = 0.0
     finish_time: float = 0.0
     finish_reason: str = ""
@@ -129,8 +130,11 @@ class Scheduler:
         self.max_seq_len = max_seq_len
         self.waiting: deque[Request] = deque()
         self.running: List[Request] = []
-        # engine hook, fired after a preemption moves a req back to waiting
+        # engine hooks: fired after a preemption moves a req back to
+        # waiting / after admission moves one to running (telemetry:
+        # queue-wait histograms and request-lifecycle spans)
         self.on_preempt: Optional[Callable[[Request], None]] = None
+        self.on_admit: Optional[Callable[[Request], None]] = None
 
     # -- intake -----------------------------------------------------------
     def add(self, req: Request) -> None:
@@ -213,6 +217,9 @@ class Scheduler:
             req.state = RUNNING
             admitted.append(req)
         self.running.extend(admitted)
+        if self.on_admit is not None:
+            for req in admitted:
+                self.on_admit(req)
         return admitted
 
     def _ensure_writable_or_preempt(self, req: Request, start: int,

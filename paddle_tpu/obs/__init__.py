@@ -1,0 +1,35 @@
+"""Serving telemetry (OBSERVABILITY.md): metrics, tracing, exposition.
+
+The layer every serving subsystem reports through:
+
+- `metrics` — thread-safe Counter/Gauge/Histogram registry with label
+  sets and log-bucketed quantiles; Prometheus text exposition +
+  periodic `obs_snapshot` JSON lines on the shared event stream.
+- `tracing` — per-request lifecycle spans (queued -> prefill ->
+  decode, preemption re-entries), exported as Chrome trace and
+  mergeable with the host profiler timeline.
+- `http` — stdlib-only `/metrics` scrape server.
+
+ServeEngine / Scheduler / PagedKVCache and the resilience runtime
+record into `default_registry()` unless constructed with an explicit
+`registry=` (what serve_bench does to isolate its A/B cells).
+"""
+
+from paddle_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    Snapshotter,
+    default_registry,
+    log_buckets,
+)
+from paddle_tpu.obs.tracing import RequestTracer, merged_chrome_trace
+from paddle_tpu.obs.http import MetricsServer
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "Snapshotter", "default_registry", "log_buckets",
+    "RequestTracer", "merged_chrome_trace", "MetricsServer",
+]

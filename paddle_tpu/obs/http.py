@@ -1,0 +1,95 @@
+"""Stdlib-only HTTP scrape endpoint for the metrics registry.
+
+One small ThreadingHTTPServer (no third-party deps — the container
+rule) serving:
+
+- `GET /metrics`  -> Prometheus text exposition 0.0.4 of the bound
+  registry (obs/metrics.py render_prometheus);
+- `GET /healthz`  -> `ok` (liveness for a replica router / k8s probe).
+
+`port=0` binds an ephemeral port (read it back from `.port` — what
+tests use); the server runs on a daemon thread so it can never hold a
+draining process open. A scrape renders under the registry locks
+child-by-child, so it is safe concurrent with the serve loop's
+recording — that is the point: pull-based exposition without pausing
+the engine.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """`with MetricsServer(registry, port=9090) as srv:` or
+    start()/stop(); `srv.url` is the scrape address."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.host = host
+        self.port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def start(self) -> "MetricsServer":
+        if self._server is not None:
+            return self
+        registry = self.registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                           # noqa: N802 (stdlib)
+                if self.path.split("?")[0] == "/metrics":
+                    body = registry.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", CONTENT_TYPE)
+                elif self.path == "/healthz":
+                    body = b"ok\n"
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"not found\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):               # silence stderr
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ptpu-metrics-http")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -1,0 +1,184 @@
+"""Per-request lifecycle tracing for the serve engine.
+
+Each request's life is a sequence of host-side SPANS —
+queued -> prefill -> decode, re-entering queued on preemption — plus
+instant marks (per prefill chunk, first token, preempt, done).
+ServeEngine/Scheduler drive the transitions (engine/engine.py), and
+the tracer turns them into:
+
+- derived latencies (`durations_ms`) — what feeds the TTFT / TPOT /
+  queue-wait / e2e histograms in the metrics registry;
+- a Chrome-trace JSON (`to_chrome_trace`) with one trace-row (tid)
+  per request, timestamped on the SAME epoch-anchored clock as the
+  host profiler's spans (profiler.now_us), so
+  `merged_chrome_trace()` lays request lifecycles and engine host
+  spans on one chrome://tracing / perfetto timeline.
+
+Completed requests are retained in a bounded deque (`keep_last`) so a
+long-lived engine cannot leak trace state; live requests hold only
+their own spans.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+from paddle_tpu.profiler.profiler import now_us
+
+# span names, in lifecycle order
+QUEUED, PREFILL, DECODE = "queued", "prefill", "decode"
+
+
+class RequestTracer:
+    """Records span transitions per req_id; every hook is a no-op when
+    `enabled` is False (flip at runtime — no engine restart)."""
+
+    def __init__(self, keep_last: int = 2048, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._events: Dict[int, List[dict]] = {}     # req_id -> events
+        self._open: Dict[int, dict] = {}             # req_id -> open span
+        self._done: Deque[Tuple[int, List[dict]]] = deque(maxlen=keep_last)
+
+    # -- lifecycle hooks (engine-facing) ----------------------------------
+    def on_enqueue(self, req_id: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open_span(req_id, QUEUED)
+
+    def on_admit(self, req_id: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._open_span(req_id, PREFILL)
+
+    def on_chunk(self, req_id: int, start: int, length: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._mark(req_id, "chunk", start=start, length=length)
+
+    def on_first_token(self, req_id: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._mark(req_id, "first_token")
+            self._open_span(req_id, DECODE)
+
+    def on_preempt(self, req_id: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._mark(req_id, "preempt")
+            self._open_span(req_id, QUEUED)   # back to the wait queue
+
+    def on_finish(self, req_id: int, reason: str = "") -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._mark(req_id, "done", reason=reason)
+            self._close_span(req_id)
+            evs = self._events.pop(req_id, None)
+            if evs is not None:
+                self._done.append((req_id, evs))
+
+    # -- internals (lock held) --------------------------------------------
+    def _open_span(self, req_id: int, name: str) -> None:
+        self._close_span(req_id)
+        ev = {"name": name, "ph": "X", "ts": now_us(), "dur": None}
+        self._open[req_id] = ev
+        self._events.setdefault(req_id, []).append(ev)
+
+    def _close_span(self, req_id: int) -> None:
+        ev = self._open.pop(req_id, None)
+        if ev is not None:
+            ev["dur"] = now_us() - ev["ts"]
+
+    def _mark(self, req_id: int, name: str, **args) -> None:
+        self._events.setdefault(req_id, []).append(
+            {"name": name, "ph": "i", "ts": now_us(), "args": args})
+
+    # -- reads ------------------------------------------------------------
+    def _events_of(self, req_id: int) -> List[dict]:
+        with self._lock:
+            evs = list(self._events.get(req_id, ()))
+            if not evs:
+                for rid, done in self._done:
+                    if rid == req_id:
+                        evs = list(done)
+            return evs
+
+    def durations_ms(self, req_id: int) -> Dict[str, float]:
+        """Total CLOSED-span wall time per phase (ms), summed across
+        preemption re-entries; phases with no closed span are absent."""
+        out: Dict[str, float] = {}
+        for ev in self._events_of(req_id):
+            if ev["ph"] == "X" and ev["dur"] is not None:
+                out[ev["name"]] = out.get(ev["name"], 0.0) + ev["dur"] / 1e3
+        return out
+
+    def to_chrome_trace(self, pid: int = 1) -> dict:
+        """Chrome trace: one tid per request, spans as 'X' (unfinished
+        ones clipped to now), marks as thread-scoped instants."""
+        with self._lock:
+            per_req = [(rid, list(evs)) for rid, evs in self._done]
+            per_req += [(rid, list(evs))
+                        for rid, evs in sorted(self._events.items())]
+        events: List[dict] = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "serve requests"}}]
+        now = now_us()
+        for rid, evs in per_req:
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": rid, "args": {"name": f"req {rid}"}})
+            for ev in evs:
+                if ev["ph"] == "X":
+                    events.append({
+                        "name": ev["name"], "ph": "X", "cat": "request",
+                        "ts": ev["ts"],
+                        "dur": ev["dur"] if ev["dur"] is not None
+                        else now - ev["ts"],
+                        "pid": pid, "tid": rid, "args": {}})
+                else:
+                    events.append({
+                        "name": ev["name"], "ph": "i", "s": "t",
+                        "cat": "request", "ts": ev["ts"],
+                        "pid": pid, "tid": rid,
+                        "args": ev.get("args", {})})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._open.clear()
+            self._done.clear()
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+
+def merged_chrome_trace(tracer: RequestTracer,
+                        include_host_spans: bool = True,
+                        path: Optional[str] = None) -> dict:
+    """Merge the request-lifecycle trace with the host profiler's
+    recorded spans (profiler.get_events between start/stop_profiler)
+    into ONE Chrome trace via the multi-process timeline merger —
+    request rows and engine host spans share the epoch-anchored
+    clock, so they line up without shifting."""
+    from paddle_tpu.profiler.profiler import events_to_chrome_trace
+    from paddle_tpu.profiler.timeline import Timeline
+
+    tl = Timeline()
+    if include_host_spans:
+        tl.add_profile("engine host", events_to_chrome_trace())
+    tl.add_profile("serve requests", tracer.to_chrome_trace())
+    trace = tl.trace()
+    if path:
+        with open(path, "w") as f:
+            json.dump(trace, f)
+    return trace

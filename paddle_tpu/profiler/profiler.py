@@ -31,8 +31,14 @@ _trace_dir: Optional[str] = None
 _EPOCH_NS = time.time_ns() - time.perf_counter_ns()
 
 
-def _now_us() -> float:
+def now_us() -> float:
+    """Epoch-anchored monotonic microseconds — the shared timestamp
+    base for host spans AND the request tracer (obs/tracing.py), so
+    their Chrome traces merge on one timeline."""
     return (_EPOCH_NS + time.perf_counter_ns()) / 1e3
+
+
+_now_us = now_us
 
 
 class RecordEvent:

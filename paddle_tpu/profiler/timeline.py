@@ -30,7 +30,10 @@ class Timeline:
             "args": {"name": name},
         })
         for ev in profile.get("traceEvents", []):
-            if ev.get("ph") == "M":
+            # the source's process_name is superseded by `name`, but
+            # thread_name rows (e.g. the request tracer's "req N"
+            # labels) must survive the merge
+            if ev.get("ph") == "M" and ev.get("name") != "thread_name":
                 continue
             ev = dict(ev)
             ev["pid"] = pid

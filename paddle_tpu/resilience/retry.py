@@ -22,7 +22,12 @@ import time
 import zlib
 from typing import Callable, Optional, Tuple, Type
 
+from paddle_tpu.obs.metrics import default_registry
 from paddle_tpu.utils.log import resilience_event
+
+_RETRIES = default_registry().counter(
+    "ptpu_resilience_retries_total",
+    "Transient-failure re-attempts", labelnames=("site",))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +86,7 @@ def retry_call(fn: Callable, *args, policy: Optional[RetryPolicy] = None,
                 raise
             if attempt >= max(1, policy.attempts):
                 raise
+            _RETRIES.labels(site=name).inc()
             resilience_event("retry", site=name, attempt=attempt,
                              of=policy.attempts,
                              next_delay_s=round(

@@ -33,11 +33,30 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from paddle_tpu.io.checkpoint import (
     CheckpointManager, checkpoint_step, latest_checkpoint)
 from paddle_tpu.resilience import chaos
+from paddle_tpu.obs.metrics import default_registry
 from paddle_tpu.resilience.errors import (
     BadStepBudgetExceeded, PREEMPT_EXIT_CODE)
 from paddle_tpu.utils.log import resilience_event
 
 Pytree = Any
+
+# resilience counters (OBSERVABILITY.md): the production-side view of
+# what the chaos harness asserts in tests — recorded alongside the
+# resilience_event stream so a scrape shows fault pressure without
+# log parsing
+_REG = default_registry()
+_PREEMPTS = _REG.counter(
+    "ptpu_resilience_preempts_total", "Preemption signals honored")
+_HANGS = _REG.counter(
+    "ptpu_resilience_hangs_total", "Steps flagged by the watchdog")
+_ROLLBACKS = _REG.counter(
+    "ptpu_resilience_rollbacks_total",
+    "Blown bad-step budgets rolled back to a checkpoint")
+_BAD_STEPS = _REG.counter(
+    "ptpu_resilience_bad_steps_total", "In-graph skipped (retried) steps")
+_EMERGENCY_CKPTS = _REG.counter(
+    "ptpu_resilience_emergency_ckpts_total",
+    "Synchronous emergency checkpoints written")
 
 
 class RunSupervisor:
@@ -123,6 +142,7 @@ class RunSupervisor:
             return latest
         path = self.manager.save(ts, step=step)
         self.manager.wait()
+        _EMERGENCY_CKPTS.inc()
         return path
 
     def maybe_preempt_exit(self, ts: Pytree, step: int) -> None:
@@ -132,6 +152,7 @@ class RunSupervisor:
         if self._signal is None:
             return
         path = self.emergency_checkpoint(ts, step)
+        _PREEMPTS.inc()
         resilience_event("preempt", signal=int(self._signal), step=step,
                          ckpt=path, exit_code=self.exit_code)
         sys.stdout.flush()
@@ -156,6 +177,7 @@ class RunSupervisor:
             if elapsed > self.watchdog_timeout_s and flagged != step:
                 flagged = step
                 self.hung_steps.append(step)
+                _HANGS.inc()
                 resilience_event("hang", step=step,
                                  elapsed_s=round(elapsed, 3),
                                  timeout_s=self.watchdog_timeout_s)
@@ -221,6 +243,7 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
                 restored, rstep = manager.restore_latest(target)
                 if restored is None:
                     raise
+                _ROLLBACKS.inc()
                 resilience_event("rollback", from_step=step,
                                  to_step=rstep, rollbacks=rollbacks)
                 ts, step = restored, rstep
@@ -229,6 +252,7 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
                     reset()
                 continue
             if fetches.pop("bad_step", False):
+                _BAD_STEPS.inc()
                 continue  # update was skipped in-graph; retry this step
             if on_step is not None:
                 on_step(step, fetches)

@@ -1,13 +1,20 @@
-"""Leveled logging (VLOG-style) for the framework.
+"""Leveled logging (VLOG-style) + the structured event streams.
 
 Analog of the reference's glog `VLOG(n)` + InitGLOG (platform/init.cc:165)
-and pretty_log (string/pretty_log.h). Verbosity from FLAGS_v / GLOG_v env.
+and pretty_log (string/pretty_log.h). Verbosity comes from FLAGS_v /
+GLOG_v, re-read PER CALL (and overridable at runtime via
+`set_verbosity`), so tests and operators can raise it mid-run —
+the old import-time read froze the level for the process lifetime.
 
-Also hosts the `resilience` event stream: single-line JSON records on
-STDOUT (`{"evt": "preempt", ...}`) so subprocess cluster tests — which
-only see a worker's captured stdout — can assert on recovery behavior
-(preemption, checkpoint rejection, bad-step skips, rollbacks, retries)
-without any side channel.
+Also hosts the unified EVENT EMITTER: every stream (`resilience`,
+`serve`, `obs`) emits single-line JSON records on STDOUT
+(`{"evt": "preempt", ...}`) so subprocess cluster tests — which only
+see a worker's captured stdout — and log scrapers consume one format.
+Every record is stamped with a monotonic `ts` (seconds,
+time.monotonic — comparable within a process, immune to wall-clock
+steps) and a per-stream `seq`, so post-hoc latency analysis and
+loss-detection work from logs alone. `evt` always sorts first, so a
+grep for '{"evt": "rollback"' keeps working.
 """
 
 from __future__ import annotations
@@ -16,7 +23,9 @@ import json
 import logging
 import os
 import sys
+import threading
 import time
+from typing import Dict, Optional
 
 _LOGGER = logging.getLogger("paddle_tpu")
 if not _LOGGER.handlers:
@@ -27,11 +36,30 @@ if not _LOGGER.handlers:
     _LOGGER.setLevel(logging.INFO)
     _LOGGER.propagate = False
 
-_VERBOSITY = int(os.environ.get("FLAGS_v", os.environ.get("GLOG_v", "0")))
+# runtime override; None defers to the env (read per call)
+_VERBOSITY_OVERRIDE: Optional[int] = None
+
+
+def get_verbosity() -> int:
+    if _VERBOSITY_OVERRIDE is not None:
+        return _VERBOSITY_OVERRIDE
+    try:
+        return int(os.environ.get("FLAGS_v", os.environ.get("GLOG_v", "0")))
+    except ValueError:
+        return 0
+
+
+def set_verbosity(level: Optional[int]) -> Optional[int]:
+    """Set the VLOG threshold at runtime (None reverts to the env
+    vars). Returns the previous override so callers can restore it."""
+    global _VERBOSITY_OVERRIDE
+    prev = _VERBOSITY_OVERRIDE
+    _VERBOSITY_OVERRIDE = None if level is None else int(level)
+    return prev
 
 
 def vlog(level: int, msg: str, *args) -> None:
-    if level <= _VERBOSITY:
+    if level <= get_verbosity():
         _LOGGER.info(msg, *args)
 
 
@@ -47,7 +75,7 @@ def error(msg: str, *args) -> None:
     _LOGGER.error(msg, *args)
 
 
-# -- resilience event stream ------------------------------------------------
+# -- unified event streams ---------------------------------------------------
 
 class _StdoutHandler(logging.Handler):
     """Writes to whatever sys.stdout is AT EMIT TIME (not at import):
@@ -63,49 +91,64 @@ class _StdoutHandler(logging.Handler):
             pass  # logging must never take the run down
 
 
-_RESILIENCE = logging.getLogger("paddle_tpu.resilience")
-if not _RESILIENCE.handlers:
-    _RESILIENCE.addHandler(_StdoutHandler())
-    _RESILIENCE.setLevel(logging.INFO)
-    _RESILIENCE.propagate = False
+_STREAMS: Dict[str, logging.Logger] = {}
+_SEQ: Dict[str, int] = {}
+_SEQ_LOCK = threading.Lock()
+
+
+def _stream_logger(stream: str) -> logging.Logger:
+    lg = _STREAMS.get(stream)
+    if lg is None:
+        lg = logging.getLogger(f"paddle_tpu.{stream}")
+        if not lg.handlers:
+            lg.addHandler(_StdoutHandler())
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+        _STREAMS[stream] = lg
+    return lg
+
+
+def emit_event(stream: str, evt: str, **fields) -> dict:
+    """One single-line JSON record on stdout; returns the dict.
+
+    "evt" sorts first so a grep for '{"evt": "rollback"' works;
+    `ts` (monotonic seconds) and `seq` (per-stream, 0-based,
+    gap-free) are stamped LAST so existing prefix-greps and field
+    consumers stay valid; non-JSON-native values go through str().
+    """
+    with _SEQ_LOCK:
+        seq = _SEQ.get(stream, 0)
+        _SEQ[stream] = seq + 1
+    rec = {"evt": evt, **fields}
+    rec["ts"] = round(time.monotonic(), 6)
+    rec["seq"] = seq
+    _stream_logger(stream).info(
+        json.dumps(rec, sort_keys=False, default=str))
+    return rec
 
 
 def resilience_event(evt: str, **fields) -> dict:
-    """Emit one single-line JSON record on stdout and return it.
-
-    Canonical events: `preempt`, `ckpt_reject`, `bad_step_skip`,
-    `rollback`, `retry`, `chaos_inject`, `hang`. "evt" sorts first so a
-    grep for '{"evt": "rollback"' works; non-JSON-native values go
-    through str().
-    """
-    rec = {"evt": evt, **fields}
-    _RESILIENCE.info(json.dumps(rec, sort_keys=False, default=str))
-    return rec
-
-
-# -- serve event stream ------------------------------------------------------
-# The online inference engine's observability channel (ENGINE.md §events):
-# same single-line-JSON-on-stdout convention as the resilience stream so
-# serve_bench / log scrapers / tests all consume one format.
-
-_SERVE = logging.getLogger("paddle_tpu.serve")
-if not _SERVE.handlers:
-    _SERVE.addHandler(_StdoutHandler())
-    _SERVE.setLevel(logging.INFO)
-    _SERVE.propagate = False
+    """Resilience stream (logger `paddle_tpu.resilience`). Canonical
+    events: `preempt`, `ckpt_reject`, `bad_step_skip`, `rollback`,
+    `retry`, `chaos_inject`, `hang`."""
+    return emit_event("resilience", evt, **fields)
 
 
 def serve_event(evt: str, **fields) -> dict:
-    """One single-line JSON serve record on stdout; returns the dict.
-
+    """Serve stream (logger `paddle_tpu.serve`, ENGINE.md §events).
     Canonical events: `serve_admit` (queue depth at admission),
     `serve_prefill` / `serve_decode` (per-step batch shape + KV-cache
     occupancy), `serve_preempt` (pool exhaustion eviction),
-    `serve_done` (per-request TTFT ms, decode tokens/sec, token count).
-    """
-    rec = {"evt": evt, **fields}
-    _SERVE.info(json.dumps(rec, sort_keys=False, default=str))
-    return rec
+    `serve_done` (per-request TTFT ms, decode tokens/sec, token
+    count)."""
+    return emit_event("serve", evt, **fields)
+
+
+def obs_event(evt: str, **fields) -> dict:
+    """Telemetry stream (logger `paddle_tpu.obs`, OBSERVABILITY.md).
+    Canonical events: `obs_snapshot` (periodic metrics-registry dump,
+    obs/metrics.py Snapshotter)."""
+    return emit_event("obs", evt, **fields)
 
 
 class scoped_timer:

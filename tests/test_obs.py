@@ -1,0 +1,438 @@
+"""Telemetry subsystem tests (obs/): registry semantics, log-bucket
+quantile accuracy, Prometheus exposition, thread safety, the scrape
+server, request tracing, and the engine integration.
+
+The quantile tests are the load-bearing ones: the histogram promises a
+RELATIVE error bounded by one bucket's growth factor (10**(1/10) ≈
+1.26 at the default layout), so every estimate is checked against
+numpy's exact quantile on distributions chosen to break bucket
+estimators — point masses, far-apart bimodals, heavy tails, values
+outside the bucket span. The engine integration pins the other
+promise: instrumentation is host-side only, so the one-compile
+invariant (compile gauge == 1) survives metrics being ON.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import (MetricsRegistry, MetricsServer, RequestTracer,
+                            log_buckets, merged_chrome_trace)
+from paddle_tpu.obs.metrics import DEFAULT_BUCKETS
+
+pytestmark = pytest.mark.obs
+
+# one bucket's growth factor bounds the relative quantile error
+GROWTH = 10 ** 0.1
+
+
+# -- histogram quantiles vs numpy ------------------------------------------
+
+def _hist_with(values):
+    reg = MetricsRegistry()
+    h = reg.histogram("t_lat_ms", "test latencies")
+    for v in values:
+        h.observe(float(v))
+    return h
+
+
+@pytest.mark.parametrize("dist,gen", [
+    ("lognormal", lambda r: r.lognormal(mean=1.5, sigma=1.2, size=5000)),
+    ("uniform", lambda r: r.uniform(0.5, 50.0, size=5000)),
+    ("pareto_heavy_tail", lambda r: (r.pareto(1.5, size=5000) + 1) * 2.0),
+    ("exponential", lambda r: r.exponential(8.0, size=5000)),
+])
+@pytest.mark.parametrize("q", [0.5, 0.9, 0.99])
+def test_quantile_bounded_relative_error(dist, gen, q):
+    rng = np.random.default_rng(7)
+    values = gen(rng)
+    h = _hist_with(values)
+    exact = float(np.quantile(values, q))
+    est = h.quantile(q)
+    # promise: within one bucket's growth factor of the exact quantile
+    assert exact / GROWTH <= est <= exact * GROWTH, \
+        f"{dist} p{int(q * 100)}: est {est} vs exact {exact}"
+
+
+def test_quantile_point_mass_is_exact():
+    h = _hist_with([3.7] * 1000)
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == pytest.approx(3.7)   # min/max clamp
+    assert h.mean() == pytest.approx(3.7)
+
+
+def test_quantile_bimodal_point_masses():
+    # 99 at 1.0 and 1 at 1000.0: the median must sit on the low mode
+    # and the max quantile on the high one — a bucket estimator without
+    # min/max clamping smears both
+    h = _hist_with([1.0] * 99 + [1000.0])
+    assert h.quantile(0.5) == pytest.approx(1.0)
+    assert h.quantile(1.0) == pytest.approx(1000.0)
+
+
+def test_quantile_outside_bucket_span_stays_in_range():
+    # everything below the lowest bound lands in bucket 0; the estimate
+    # must still be clamped inside the observed range
+    vals = [2e-5, 5e-5, 8e-5]
+    h = _hist_with(vals)
+    for q in (0.1, 0.5, 0.9):
+        assert min(vals) <= h.quantile(q) <= max(vals)
+    big = [5e8, 6e8]                   # above the highest bound
+    h2 = _hist_with(big)
+    assert min(big) <= h2.quantile(0.5) <= max(big)
+
+
+def test_quantile_empty_is_nan():
+    h = _hist_with([])
+    assert np.isnan(h.quantile(0.5))
+    assert np.isnan(h.mean())
+
+
+def test_log_buckets_layout():
+    b = log_buckets(1e-3, 1e7, per_decade=10)
+    assert b == DEFAULT_BUCKETS
+    assert len(b) == 101                       # 10 decades x 10 + 1
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] == pytest.approx(1e7)
+    ratios = [b[i + 1] / b[i] for i in range(len(b) - 1)]
+    assert all(r == pytest.approx(GROWTH, rel=1e-9) for r in ratios)
+
+
+# -- label sets and registry semantics -------------------------------------
+
+def test_labels_identity_is_order_insensitive():
+    reg = MetricsRegistry()
+    c = reg.counter("t_req_total", "reqs", labelnames=("code", "route"))
+    a = c.labels(code="200", route="/x")
+    b = c.labels(route="/x", code="200")        # kwargs order irrelevant
+    assert a is b
+    assert c.labels(code="500", route="/x") is not a
+    a.inc(2)
+    assert c.labels(code="200", route="/x").value == 2
+    assert c.total() == 2
+
+
+def test_labels_schema_enforced():
+    reg = MetricsRegistry()
+    c = reg.counter("t_req_total", "reqs", labelnames=("code",))
+    with pytest.raises(ValueError):
+        c.labels(status="200")                  # wrong label name
+    with pytest.raises(ValueError):
+        c.labels()                              # missing label
+    with pytest.raises(ValueError):
+        c.inc()                                 # labelled family: no default
+
+
+def test_registry_get_or_create_and_mismatch():
+    reg = MetricsRegistry()
+    g1 = reg.gauge("t_depth", "depth")
+    assert reg.gauge("t_depth") is g1           # same family back
+    with pytest.raises(ValueError):
+        reg.counter("t_depth")                  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.gauge("t_depth", labelnames=("x",))  # label-schema mismatch
+    assert reg.get("t_depth") is g1
+    assert reg.get("nope") is None
+
+
+def test_counter_rejects_negative():
+    reg = MetricsRegistry()
+    c = reg.counter("t_n_total")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_reset_zeroes_in_place():
+    # instrumented code caches child handles; reset must zero THOSE,
+    # not swap in fresh children behind their back
+    reg = MetricsRegistry()
+    c = reg.counter("t_n_total")
+    h = reg.histogram("t_lat_ms")
+    child = reg.counter("t_l_total", labelnames=("k",)).labels(k="a")
+    c.inc(5)
+    h.observe(1.0)
+    child.inc(3)
+    reg.reset()
+    assert c.value == 0 and h.count == 0 and child.value == 0
+    child.inc()                                 # old handle still live
+    assert reg.get("t_l_total").labels(k="a").value == 1
+
+
+# -- exposition golden test -------------------------------------------------
+
+def test_render_prometheus_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("t_req_total", "Requests", labelnames=("code",))
+    c.labels(code="200").inc(3)
+    c.labels(code="500").inc()
+    reg.gauge("t_depth", "Depth").set(2)
+    h = reg.histogram("t_lat", "Latency", buckets=[1, 10, 100])
+    for v in (0.5, 5.0, 500.0):
+        h.observe(v)
+    assert reg.render_prometheus() == """\
+# HELP t_depth Depth
+# TYPE t_depth gauge
+t_depth 2
+# HELP t_lat Latency
+# TYPE t_lat histogram
+t_lat_bucket{le="1"} 1
+t_lat_bucket{le="10"} 2
+t_lat_bucket{le="100"} 2
+t_lat_bucket{le="+Inf"} 3
+t_lat_sum 505.5
+t_lat_count 3
+# HELP t_req_total Requests
+# TYPE t_req_total counter
+t_req_total{code="200"} 3
+t_req_total{code="500"} 1
+"""
+
+
+def test_exposition_escapes_label_values():
+    reg = MetricsRegistry()
+    reg.counter("t_e_total", labelnames=("p",)).labels(p='a"b\\c\nd').inc()
+    text = reg.render_prometheus()
+    assert 't_e_total{p="a\\"b\\\\c\\nd"} 1' in text
+
+
+# -- thread safety ----------------------------------------------------------
+
+def test_concurrent_increments_lose_nothing():
+    reg = MetricsRegistry()
+    c = reg.counter("t_n_total")
+    h = reg.histogram("t_lat_ms")
+    n_threads, per = 8, 5000
+
+    def work():
+        for _ in range(per):
+            c.inc()
+            h.observe(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per
+    assert h.count == n_threads * per
+    assert h.sum == pytest.approx(n_threads * per)
+
+
+# -- snapshot ---------------------------------------------------------------
+
+def test_snapshot_and_emit(capsys):
+    reg = MetricsRegistry()
+    reg.counter("t_n_total").inc(4)
+    reg.histogram("t_lat_ms").observe(2.0)
+    snap = reg.snapshot()
+    assert snap["t_n_total"] == 4
+    assert snap["t_lat_ms"]["count"] == 1
+    assert snap["t_lat_ms"]["p50"] == pytest.approx(2.0)
+    rec = reg.emit_snapshot(reason="test")
+    out = capsys.readouterr().out.strip().splitlines()
+    line = [ln for ln in out if ln.startswith('{"evt": "obs_snapshot"')]
+    assert len(line) == 1
+    parsed = json.loads(line[0])
+    assert parsed["metrics"]["t_n_total"] == 4
+    assert parsed["reason"] == "test"
+    assert "ts" in parsed and "seq" in parsed
+    assert rec["evt"] == "obs_snapshot"
+
+
+# -- scrape server ----------------------------------------------------------
+
+def test_metrics_http_server():
+    reg = MetricsRegistry()
+    reg.counter("t_scrape_total").inc(7)
+    with MetricsServer(reg, port=0) as srv:
+        assert srv.port != 0                    # ephemeral port bound
+        with urllib.request.urlopen(srv.url) as resp:
+            assert resp.status == 200
+            assert "0.0.4" in resp.headers["Content-Type"]
+            body = resp.read().decode()
+        assert "t_scrape_total 7" in body
+        health = urllib.request.urlopen(
+            f"http://{srv.host}:{srv.port}/healthz")
+        assert health.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{srv.host}:{srv.port}/nope")
+        assert ei.value.code == 404
+
+
+# -- verbosity (utils/log satellite) ----------------------------------------
+
+def test_verbosity_reread_per_call(monkeypatch):
+    from paddle_tpu.utils import log as ptlog
+    monkeypatch.delenv("FLAGS_v", raising=False)
+    monkeypatch.delenv("GLOG_v", raising=False)
+    assert ptlog.get_verbosity() == 0
+    monkeypatch.setenv("FLAGS_v", "3")          # env change mid-run
+    assert ptlog.get_verbosity() == 3
+    monkeypatch.setenv("FLAGS_v", "bogus")
+    assert ptlog.get_verbosity() == 0
+    prev = ptlog.set_verbosity(5)               # runtime override wins
+    try:
+        assert prev is None
+        assert ptlog.get_verbosity() == 5
+        monkeypatch.setenv("FLAGS_v", "1")
+        assert ptlog.get_verbosity() == 5
+    finally:
+        ptlog.set_verbosity(prev)
+    assert ptlog.get_verbosity() == 1           # reverted to the env
+
+
+# -- request tracer ---------------------------------------------------------
+
+def _trace_one_lifecycle(tracer, rid, preempt=False):
+    tracer.on_enqueue(rid)
+    tracer.on_admit(rid)
+    tracer.on_chunk(rid, 0, 16)
+    if preempt:
+        tracer.on_preempt(rid)
+        tracer.on_admit(rid)
+        tracer.on_chunk(rid, 0, 16)
+    tracer.on_first_token(rid)
+    tracer.on_finish(rid, reason="length")
+
+
+def test_tracer_durations_and_phases():
+    tr = RequestTracer()
+    _trace_one_lifecycle(tr, 1)
+    d = tr.durations_ms(1)
+    assert set(d) == {"queued", "prefill", "decode"}
+    assert all(v >= 0 for v in d.values())
+
+
+def test_tracer_preemption_reenters_queued():
+    tr = RequestTracer()
+    _trace_one_lifecycle(tr, 2, preempt=True)
+    trace = tr.to_chrome_trace()
+    names = [e["name"] for e in trace["traceEvents"]
+             if e.get("tid") == 2]
+    assert names.count("queued") == 2           # initial + re-entry
+    assert names.count("prefill") == 2
+    assert "preempt" in names and "first_token" in names
+
+
+def test_tracer_bounded_retention():
+    tr = RequestTracer(keep_last=2)
+    for rid in range(5):
+        _trace_one_lifecycle(tr, rid)
+    assert tr.durations_ms(0) == {}             # evicted
+    assert tr.durations_ms(4)                   # newest retained
+
+
+def test_tracer_disabled_is_noop():
+    tr = RequestTracer(enabled=False)
+    _trace_one_lifecycle(tr, 1)
+    assert tr.durations_ms(1) == {}
+    assert len(tr.to_chrome_trace()["traceEvents"]) == 1  # process meta
+
+
+def test_merged_chrome_trace_structure(tmp_path):
+    tr = RequestTracer()
+    _trace_one_lifecycle(tr, 3)
+    out = tmp_path / "trace.json"
+    trace = merged_chrome_trace(tr, path=str(out))
+    evs = trace["traceEvents"]
+    # distinct pids per merged profile + thread_name metadata per request
+    assert len({e["pid"] for e in evs}) >= 2
+    metas = [e for e in evs if e["ph"] == "M"
+             and e["name"] == "thread_name"]
+    assert any(e["args"]["name"] == "req 3" for e in metas)
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+# -- engine integration -----------------------------------------------------
+
+@pytest.mark.serve
+class TestEngineTelemetry:
+    @pytest.fixture(scope="class")
+    def served(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.engine import ServeEngine
+        from paddle_tpu.models.transformer import CausalLM
+
+        model = CausalLM(vocab=61, model_dim=16, num_heads=4,
+                         num_layers=2, ffn_dim=32, dropout=0.0,
+                         max_len=64)
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 4), jnp.int32))
+        eng = ServeEngine(model, variables, max_batch_size=4,
+                          block_size=4, num_blocks=64,
+                          registry=MetricsRegistry(),
+                          max_prefill_tokens=8)
+        prompts = [[5, 9, 2], [7, 1, 1, 3, 8], [4],
+                   [11, 12, 13, 14, 15, 16, 17, 18, 19, 20]]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        # second wave on the SAME engine: any recompile would show in
+        # the gauge
+        eng.generate(prompts[:2], max_new_tokens=4)
+        return eng, prompts, outs
+
+    def test_latency_histograms_populated(self, served):
+        eng, prompts, _ = served
+        n = len(prompts) + 2                    # both waves finished
+        assert eng.obs.get("ptpu_serve_ttft_ms").count == n
+        assert eng.obs.get("ptpu_serve_e2e_ms").count == n
+        assert eng.obs.get("ptpu_serve_queue_wait_ms").count == n
+        # every request generated >= 2 tokens, so TPOT exists for all
+        assert eng.obs.get("ptpu_serve_tpot_ms").count == n
+        assert eng.obs.get("ptpu_serve_ttft_ms").quantile(0.5) > 0
+
+    def test_compile_gauge_stays_one(self, served):
+        eng, _, _ = served
+        # the one-compile invariant with metrics ON: the whole point of
+        # host-side-only instrumentation
+        assert eng.obs.get("ptpu_engine_compiles").value == 1.0
+        assert eng.obs.get("ptpu_serve_step_ms").total_count() > 0
+
+    def test_request_and_token_counters(self, served):
+        eng, prompts, outs = served
+        reqs = eng.obs.get("ptpu_serve_requests_total")
+        assert reqs.labels(reason="length").value == len(prompts) + 2
+        toks = eng.obs.get("ptpu_serve_tokens_total")
+        assert toks.labels(kind="generated").value == \
+            sum(len(o) for o in outs) + 2 * 4
+        assert toks.labels(kind="prefill").value > 0
+
+    def test_cache_and_scheduler_gauges(self, served):
+        eng, _, _ = served
+        for name in ("ptpu_kv_occupancy", "ptpu_kv_hit_rate",
+                     "ptpu_sched_queue_depth", "ptpu_sched_running"):
+            assert eng.obs.get(name) is not None
+        text = eng.metrics_text()
+        assert "ptpu_kv_occupancy" in text
+        assert "ptpu_serve_ttft_ms_bucket" in text
+
+    def test_tracer_recorded_lifecycles(self, served):
+        eng, _, _ = served
+        rid = sorted(eng.finished)[-1]
+        d = eng.tracer.durations_ms(rid)
+        assert "prefill" in d and "decode" in d
+        trace = merged_chrome_trace(eng.tracer)
+        assert any(e.get("args", {}).get("name") == f"req {rid}"
+                   for e in trace["traceEvents"])
+
+    def test_private_registries_do_not_cross_pollute(self, served):
+        eng, _, _ = served
+        from paddle_tpu.obs.metrics import default_registry
+        # other tests in the process may use the default registry, so
+        # check isolation incrementally: traffic on THIS engine must
+        # not advance the process-wide series
+        assert eng.obs is not default_registry()
+
+        def default_ttft_count():
+            fam = default_registry().get("ptpu_serve_ttft_ms")
+            return fam.count if fam is not None else 0
+
+        before = default_ttft_count()
+        n = eng.obs.get("ptpu_serve_ttft_ms").count
+        eng.generate([[3, 4, 5]], max_new_tokens=3)
+        assert eng.obs.get("ptpu_serve_ttft_ms").count == n + 1
+        assert default_ttft_count() == before

@@ -409,6 +409,23 @@ def test_resilience_event_is_single_line_json(capsys):
     out = capsys.readouterr().out.strip().splitlines()
     assert len(out) == 1
     parsed = json.loads(out[0])
+    # every record carries a monotonic ts and a per-stream seq (stamped
+    # after the caller's fields, so the '{"evt": ...' prefix holds)
+    assert isinstance(parsed.pop("ts"), float)
+    assert isinstance(parsed.pop("seq"), int)
     assert parsed == {"evt": "rollback", "from_step": 9, "to_step": 6}
     assert rec["evt"] == "rollback"
     assert out[0].startswith('{"evt": "rollback"')
+
+
+def test_event_seq_is_per_stream_and_gap_free(capsys):
+    from paddle_tpu.utils.log import serve_event
+    a = resilience_event("retry", site="x", attempt=1)
+    s1 = serve_event("serve_admit", queue_depth=0)
+    b = resilience_event("retry", site="x", attempt=2)
+    s2 = serve_event("serve_admit", queue_depth=1)
+    # each stream's counter is gap-free and independent of the other's
+    assert b["seq"] == a["seq"] + 1
+    assert s2["seq"] == s1["seq"] + 1
+    assert b["ts"] >= a["ts"]                # monotonic within a stream
+    capsys.readouterr()

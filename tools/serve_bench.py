@@ -1,4 +1,4 @@
-"""Serving microbench: batching, prefix sharing, chunked prefill.
+"""Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
 Four scenarios, each an acceptance property of the engine subsystem
 (ENGINE.md), each verified on the SAME model with EXACT token identity
@@ -17,10 +17,19 @@ makes identity, not closeness, the bar):
            bounded), at identical outputs.
 - mixed:   mixed prefill+decode traffic through the unified ragged
            step must trigger ZERO recompiles after the first warmup
-           step (every step shares one flat-packed compiled shape —
-           counted via the jit cache), while keeping the chunked
-           worst-case step bound and exact token identity vs the
-           monolithic-budget engine.
+           step, keep the chunked worst-case step bound, stay
+           token-identical to the monolithic-budget engine — AND
+           produce a complete Prometheus exposition (non-empty TTFT /
+           TPOT / step-latency histograms, occupancy + hit-rate
+           gauges, compile-count gauge == 1). Metrics are ON for every
+           scenario, so the latency bounds double as the
+           observability-overhead guard: instrumentation that slowed
+           the hot path would blow the same verdicts.
+
+Verdict inputs come from the metrics REGISTRY (paddle_tpu/obs/) — the
+same TTFT/TPOT/hit-rate/step-latency series a production scrape reads
+— not from ad-hoc bench counters. Each engine gets a PRIVATE registry
+so A/B cells can't pollute each other.
 
 One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 (flushed — a harness timeout still sees every completed cell):
@@ -30,7 +39,9 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 
 Exit code: 0 iff every scenario's verdict holds.
 
-Run: python tools/serve_bench.py [--scenario all|batch|prefix|chunked]
+Run: python tools/serve_bench.py [--scenario all|batch|prefix|chunked|mixed]
+     [--metrics-out FILE]   # dump the last verdict engine's Prometheus
+                            # exposition at end of run
 """
 
 import argparse
@@ -41,6 +52,10 @@ import time
 import _bootstrap  # noqa: F401  (repo path + cpu override)
 
 import numpy as np
+
+# exposition of the most recent scenario's verdict engine; --metrics-out
+# writes it at end of run (the mixed scenario's when it ran)
+LAST_EXPOSITION = ""
 
 
 def emit(obj):
@@ -64,11 +79,23 @@ def build_model(args):
 
 def make_engine(model, variables, args, **kw):
     from paddle_tpu.engine import ServeEngine
+    from paddle_tpu.obs import MetricsRegistry
 
     kw.setdefault("max_batch_size", args.batch)
     kw.setdefault("block_size", args.block_size)
     kw.setdefault("num_blocks", args.num_blocks)
+    kw.setdefault("registry", MetricsRegistry())
     return ServeEngine(model, variables, **kw)
+
+
+def _hist(eng, name):
+    """A histogram family from this engine's registry."""
+    return eng.obs.get(name)
+
+
+def _gauge_value(eng, name):
+    fam = eng.obs.get(name)
+    return fam.value if fam is not None else float("nan")
 
 
 def serve_turns(eng, prompts, new_tokens):
@@ -76,21 +103,21 @@ def serve_turns(eng, prompts, new_tokens):
     arrives — the shared-system-prompt conversation pattern). TTFT is
     then pure prefill latency, undiluted by queue wait or decode, so
     the prefix cache's effect on it is directly visible. Returns
-    (outs, mean TTFT ms, wall s)."""
-    outs, ttft = [], []
+    (outs, wall s); latency stats ride the engine's registry."""
+    outs = []
     t0 = time.perf_counter()
     for p in prompts:
         r = eng.add_request(p, max_new_tokens=new_tokens)
         eng.run()
         outs.append(eng._generated_of(r))
-        ttft.append((r.first_token_time - r.enqueue_time) * 1e3)
     wall = time.perf_counter() - t0
-    return outs, float(np.mean(ttft)), wall
+    return outs, wall
 
 
 # -- scenario: continuous batching vs sequential ---------------------------
 
 def scenario_batch(model, variables, args):
+    global LAST_EXPOSITION
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, args.vocab,
                             rng.integers(4, args.prompt_len + 1)).tolist()
@@ -102,6 +129,7 @@ def scenario_batch(model, variables, args):
         # warmup on THIS engine: compile the unified step outside the
         # timed window so both modes measure steady state
         eng.generate([prompts[0]], max_new_tokens=2)
+        eng.reset_stats()
         t0 = time.perf_counter()
         if batched:
             outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
@@ -110,13 +138,16 @@ def scenario_batch(model, variables, args):
             outs = [eng.generate([p], max_new_tokens=args.new_tokens)[0]
                     for p in prompts]
         wall = time.perf_counter() - t0
-        toks = sum(len(o) for o in outs)
+        # generated-token throughput straight from the registry counter
+        toks = int(eng.obs.get("ptpu_serve_tokens_total")
+                   .labels(kind="generated").value)
         name = "batched" if batched else "sequential"
         cells[name] = {"cell": name, "requests": len(prompts),
                        "generated_tokens": toks, "wall_s": round(wall, 3),
                        "tok_s": round(toks / wall, 2)}
         cells[name + "_outs"] = outs
         emit(cells[name])
+        LAST_EXPOSITION = eng.metrics_text()
     identical = cells["batched_outs"] == cells["sequential_outs"]
     faster = cells["batched"]["tok_s"] > cells["sequential"]["tok_s"]
     ok = bool(faster and identical)
@@ -130,6 +161,7 @@ def scenario_batch(model, variables, args):
 # -- scenario: shared system prompt, prefix cache on vs off ----------------
 
 def scenario_prefix(model, variables, args):
+    global LAST_EXPOSITION
     rng = np.random.default_rng(1)
     system = rng.integers(0, args.vocab - 1, args.system_len).tolist()
     prompts = [system + rng.integers(0, args.vocab - 1,
@@ -150,20 +182,27 @@ def scenario_prefix(model, variables, args):
         # every chunk/decode mix)
         eng.generate([warm_long], max_new_tokens=2)
         eng.reset_stats()
-        outs, mean_ttft, wall = serve_turns(eng, prompts, args.new_tokens)
-        stats = eng.stats()
+        outs, wall = serve_turns(eng, prompts, args.new_tokens)
+        # verdict inputs from the REGISTRY: the TTFT histogram and the
+        # hit-rate gauge a production scrape would read
+        ttft = _hist(eng, "ptpu_serve_ttft_ms")
+        prefill_computed = int(eng.obs.get("ptpu_serve_tokens_total")
+                               .labels(kind="prefill").value)
         name = "prefix_shared" if enabled else "prefix_baseline"
         results[name] = {
             "cell": name, "requests": len(prompts),
             "prompt_len": len(prompts[0]), "wall_s": round(wall, 3),
-            "mean_ttft_ms": round(mean_ttft, 3),
-            "prefill_tokens_computed": stats["prefill_tokens_computed"],
-            "hit_rate": stats["hit_rate"],
-            "cow_copies": stats["cow_copies"],
-            "peak_occupancy": stats["peak_occupancy"]}
+            "mean_ttft_ms": round(ttft.mean(), 3),
+            "p90_ttft_ms": round(ttft.quantile(0.9), 3),
+            "prefill_tokens_computed": prefill_computed,
+            "hit_rate": round(_gauge_value(eng, "ptpu_kv_hit_rate"), 4),
+            "cow_copies": int(eng.obs.get(
+                "ptpu_kv_cow_copies_total").value),
+            "peak_occupancy": eng.stats()["peak_occupancy"]}
         results[name + "_outs"] = outs
         emit(results[name])
         eng.cache.assert_quiesced()
+        LAST_EXPOSITION = eng.metrics_text()
     shared, base = results["prefix_shared"], results["prefix_baseline"]
     identical = results["prefix_shared_outs"] == results[
         "prefix_baseline_outs"]
@@ -185,8 +224,9 @@ def scenario_prefix(model, variables, args):
 # -- scenario: chunked vs monolithic prefill -------------------------------
 
 def _run_chunked_cell(model, variables, args, budget):
-    """One short decoding request + one long prompt arriving mid-serve;
-    per-step wall times timed individually. Returns (cell, outs)."""
+    """One short decoding request + one long prompt arriving mid-serve.
+    Step latency comes from the registry's step histogram (max over
+    the kind-labelled children). Returns (cell, outs, engine)."""
     eng = make_engine(model, variables, args, max_prefill_tokens=budget)
     warm = [args.vocab - 1] * args.system_len
     eng.generate([warm], max_new_tokens=2)          # compile untimed
@@ -198,28 +238,33 @@ def _run_chunked_cell(model, variables, args, budget):
     r_short = eng.add_request(short, max_new_tokens=args.new_tokens)
     for _ in range(2):                              # short reaches decode
         eng.step()
+    # measure the CONTENTION window only: zero the registry so the step
+    # histogram starts where the long prompt streams in against running
+    # decodes (the first dispatch after an idle engine carries ~5x
+    # latency noise that would otherwise own the max)
+    eng.obs.reset()
     r_long = eng.add_request(long_p, max_new_tokens=4)
-    step_times = []
-    while True:
-        t0 = time.perf_counter()
-        if not eng.step():
-            break
-        step_times.append(time.perf_counter() - t0)
+    while eng.step():
+        pass
     outs = [eng._generated_of(r_short), eng._generated_of(r_long)]
+    step_h = _hist(eng, "ptpu_serve_step_ms")
     return {"cell": f"chunked_budget_{budget}",
-            "max_step_ms": round(max(step_times) * 1e3, 3),
-            "mean_step_ms": round(float(np.mean(step_times)) * 1e3, 3),
-            "steps": len(step_times),
-            "max_chunk_tokens": eng.max_chunk_tokens}, outs
+            "max_step_ms": round(step_h.max_value(), 3),
+            "mean_step_ms": round(step_h.total_sum()
+                                  / max(step_h.total_count(), 1), 3),
+            "steps": step_h.total_count(),
+            "max_chunk_tokens": eng.max_chunk_tokens}, outs, eng
 
 
 def scenario_chunked(model, variables, args):
-    mono, mono_outs = _run_chunked_cell(model, variables, args,
-                                        budget=args.max_len)
+    global LAST_EXPOSITION
+    mono, mono_outs, _ = _run_chunked_cell(model, variables, args,
+                                           budget=args.max_len)
     emit(mono)
-    chunk, chunk_outs = _run_chunked_cell(model, variables, args,
-                                          budget=args.chunk_tokens)
+    chunk, chunk_outs, eng = _run_chunked_cell(model, variables, args,
+                                               budget=args.chunk_tokens)
     emit(chunk)
+    LAST_EXPOSITION = eng.metrics_text()
     identical = chunk_outs == mono_outs
     ok = bool(identical
               and chunk["max_step_ms"] < mono["max_step_ms"]
@@ -233,7 +278,25 @@ def scenario_chunked(model, variables, args):
     return ok
 
 
-# -- scenario: mixed traffic, one compiled step ----------------------------
+# -- scenario: mixed traffic, one compiled step + full telemetry -----------
+
+def _exposition_complete(eng):
+    """The acceptance-criteria checks on the Prometheus exposition:
+    non-empty TTFT/TPOT/step histograms, occupancy + hit-rate gauges
+    present, compile-count gauge exactly 1."""
+    text = eng.metrics_text()
+    checks = {
+        "ttft_populated": _hist(eng, "ptpu_serve_ttft_ms").count > 0,
+        "tpot_populated": _hist(eng, "ptpu_serve_tpot_ms").count > 0,
+        "step_populated": _hist(eng, "ptpu_serve_step_ms")
+                          .total_count() > 0,
+        "occupancy_gauge": "ptpu_kv_occupancy" in text,
+        "hit_rate_gauge": "ptpu_kv_hit_rate" in text,
+        "compile_gauge_is_1":
+            _gauge_value(eng, "ptpu_engine_compiles") == 1.0,
+    }
+    return checks, text
+
 
 def _run_mixed_cell(model, variables, args, budget):
     """Two short requests decoding while two long prompts (different
@@ -254,36 +317,49 @@ def _run_mixed_cell(model, variables, args, budget):
           for p in shorts]
     for _ in range(2):                              # shorts reach decode
         eng.step()
+    # same contention-window reset as the chunked cells; every request
+    # finishes after this point, so the TTFT/TPOT histograms the
+    # exposition checks read still populate
+    eng.obs.reset()
     rl = [eng.add_request(p, max_new_tokens=4) for p in longs]
-    step_times = []
-    while True:
-        t0 = time.perf_counter()
-        if not eng.step():
-            break
-        step_times.append(time.perf_counter() - t0)
+    while eng.step():
+        pass
     outs = [eng._generated_of(r) for r in rs + rl]
     recompiles = eng._step_fn._cache_size() - compiles_before
+    step_h = _hist(eng, "ptpu_serve_step_ms")
+    tpot_h = _hist(eng, "ptpu_serve_tpot_ms")
     return {"cell": f"mixed_budget_{budget}",
             "recompiles": int(recompiles),
             "step_compiles_total": int(eng._step_fn._cache_size()),
-            "max_step_ms": round(max(step_times) * 1e3, 3),
-            "mean_step_ms": round(float(np.mean(step_times)) * 1e3, 3),
-            "steps": len(step_times),
-            "max_chunk_tokens": eng.max_chunk_tokens}, outs
+            "max_step_ms": round(step_h.max_value(), 3),
+            "mean_step_ms": round(step_h.total_sum()
+                                  / max(step_h.total_count(), 1), 3),
+            "p99_step_ms": round(max(
+                c.quantile(0.99) for c in step_h.children().values()
+                if c.count), 3),
+            "mean_tpot_ms": round(tpot_h.mean(), 3),
+            "steps": step_h.total_count(),
+            "max_chunk_tokens": eng.max_chunk_tokens}, outs, eng
 
 
 def scenario_mixed(model, variables, args):
-    mono, mono_outs = _run_mixed_cell(model, variables, args,
-                                      budget=args.max_len)
+    global LAST_EXPOSITION
+    mono, mono_outs, _ = _run_mixed_cell(model, variables, args,
+                                         budget=args.max_len)
     emit(mono)
-    mixed, mixed_outs = _run_mixed_cell(model, variables, args,
-                                        budget=args.chunk_tokens)
+    mixed, mixed_outs, eng = _run_mixed_cell(model, variables, args,
+                                             budget=args.chunk_tokens)
     emit(mixed)
+    checks, LAST_EXPOSITION = _exposition_complete(eng)
     identical = mixed_outs == mono_outs
+    # max-step bound with metrics ON is the observability-overhead
+    # guard: instrumentation that slowed the one-compile hot path
+    # would push mixed's max step past the monolithic cell's
     ok = bool(identical
               and mixed["recompiles"] == 0
               and mixed["step_compiles_total"] == 1
-              and mixed["max_step_ms"] < mono["max_step_ms"])
+              and mixed["max_step_ms"] < mono["max_step_ms"]
+              and all(checks.values()))
     emit({"cell": "mixed_verdict", "ok": ok,
           "tokens_identical": bool(identical),
           "recompiles": mixed["recompiles"],
@@ -291,7 +367,8 @@ def scenario_mixed(model, variables, args):
               bool(mixed["step_compiles_total"] == 1),
           "max_step_speedup": round(mono["max_step_ms"]
                                     / max(mixed["max_step_ms"], 1e-9),
-                                    2)})
+                                    2),
+          **{f"metrics_{k}": bool(v) for k, v in checks.items()}})
     return ok
 
 
@@ -313,6 +390,9 @@ def main():
     ap.add_argument("--max-len", type=int, default=128)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=256)
+    ap.add_argument("--metrics-out", default=None, metavar="FILE",
+                    help="write the last verdict engine's Prometheus "
+                    "exposition here at end of run")
     args = ap.parse_args()
 
     model, variables = build_model(args)
@@ -323,6 +403,11 @@ def main():
     oks = {}
     for name in run:
         oks[name] = scenarios[name](model, variables, args)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(LAST_EXPOSITION)
+        emit({"cell": "metrics_out", "path": args.metrics_out,
+              "bytes": len(LAST_EXPOSITION)})
     emit({"cell": "TOTAL", "ok": all(oks.values()), **oks})
     return 0 if all(oks.values()) else 1
 
