@@ -253,7 +253,7 @@ class ServeEngine:
             labelnames=("kind",))        # kind=decode|prefill|mixed
         self._m_reqs = m.counter(
             "ptpu_serve_requests_total", "Finished requests",
-            labelnames=("reason",))      # reason=eos|length
+            labelnames=("reason",))      # reason=eos|length|cancelled
         self._m_tokens = m.counter(
             "ptpu_serve_tokens_total", "Token flow through the engine",
             labelnames=("kind",))        # kind=prefill|cached|generated
@@ -295,7 +295,16 @@ class ServeEngine:
             self._m_queue_wait.observe((now - req.enqueue_time) * 1e3)
         req.admit_time = now
         self.tracer.on_admit(req.req_id)
+        self._set_sched_gauges()
+
+    def _set_sched_gauges(self) -> None:
+        """Refresh queue-depth/running on EVERY membership change
+        (admit, finish, cancel, preempt, enqueue) — not only at step
+        end. The replica router scrapes between steps; a gauge that
+        lags until the next step() would route traffic on stale
+        depth."""
         self._m_queue_depth.set(self.scheduler.queue_depth)
+        self._m_running.set(len(self.scheduler.running))
 
     def metrics_text(self) -> str:
         """Prometheus exposition of this engine's registry (the
@@ -306,8 +315,8 @@ class ServeEngine:
     def add_request(self, prompt: List[int], max_new_tokens: int = 32,
                     temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                     eos_id: Optional[int] = None,
-                    callback: Optional[Callable[[int], None]] = None
-                    ) -> Request:
+                    callback: Optional[Callable[[int], None]] = None,
+                    deadline_ms: Optional[float] = None) -> Request:
         if not prompt:
             raise ValueError("empty prompt")
         if len(prompt) + 1 > self.max_seq_len:
@@ -322,13 +331,39 @@ class ServeEngine:
                       temperature=temperature, top_k=top_k, seed=seed,
                       eos_id=eos_id, callback=callback)
         req.enqueue_time = time.monotonic()
+        if deadline_ms is not None:
+            # absolute completion deadline: the scheduler preempts the
+            # slackest request first, so a tight deadline shields KV
+            # state under pool pressure
+            req.deadline = req.enqueue_time + deadline_ms / 1e3
         self.scheduler.add(req)
         self.tracer.on_enqueue(req.req_id)
-        self._m_queue_depth.set(self.scheduler.queue_depth)
+        self._set_sched_gauges()
         serve_event("serve_admit", req_id=req.req_id,
                     prompt_len=len(prompt),
                     queue_depth=self.scheduler.queue_depth)
         return req
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> bool:
+        """Tear a request down mid-flight (client disconnect): frees
+        its KV blocks (shared prefix blocks drop one refcount), counts
+        it under requests{reason=...}, and closes its trace. Returns
+        False when it already finished. Engine-thread only, between
+        steps — the HTTP front-end marshals disconnects through the
+        serve loop (serve/frontend.py)."""
+        if not self.scheduler.cancel(req):
+            return False
+        req.finish_time = time.monotonic()
+        req.finish_reason = reason
+        self.finished[req.req_id] = req
+        self._m_reqs.labels(reason=reason).inc()
+        self._set_sched_gauges()
+        self._m_occ.set(self.cache.occupancy())
+        self.tracer.on_finish(req.req_id, reason)
+        serve_event("serve_cancel", req_id=req.req_id, reason=reason,
+                    tokens=req.num_generated,
+                    occupancy=round(self.cache.occupancy(), 4))
+        return True
 
     # -- serve loop --------------------------------------------------------
     def step(self) -> bool:
@@ -509,6 +544,7 @@ class ServeEngine:
         if n_gen > 1:
             self._m_tpot.observe(decode_s * 1e3 / (n_gen - 1))
         self._m_reqs.labels(reason=reason).inc()
+        self._set_sched_gauges()
         self.tracer.on_finish(req.req_id, reason)
         serve_event("serve_done", req_id=req.req_id, reason=reason,
                     tokens=n_gen, ttft_ms=round(ttft_ms, 3),
@@ -518,6 +554,7 @@ class ServeEngine:
 
     def _on_preempt(self, req: Request) -> None:
         self._m_preempts.inc()
+        self._set_sched_gauges()
         self.tracer.on_preempt(req.req_id)
         serve_event("serve_preempt", req_id=req.req_id,
                     kept_tokens=len(req.prompt),

@@ -66,6 +66,11 @@ class Request:
     seed: int = 0
     eos_id: Optional[int] = None
     callback: Optional[Callable[[int], None]] = None  # per-token stream
+    # absolute monotonic completion deadline (inf = none). The
+    # scheduler's preemption choice reads it: the victim is the running
+    # request with the MOST slack, so tight-deadline requests keep
+    # their KV state under pool pressure.
+    deadline: float = float("inf")
     req_id: int = field(default_factory=lambda: next(_req_ids))
     generated: List[int] = field(default_factory=list)
     state: str = WAITING
@@ -254,12 +259,18 @@ class Scheduler:
         return False
 
     def _pick_victim(self, keep: Request) -> Optional[Request]:
-        """Last-admitted running request other than `keep`; None when
-        nothing else is left to evict."""
-        for r in reversed(self.running):
-            if r is not keep:
-                return r
-        return None
+        """The running request (other than `keep`) with the MOST
+        deadline slack — a recompute preemption costs its victim a full
+        re-prefill, so it should land on the request that can best
+        absorb it. Without deadlines every slack is +inf and the choice
+        degrades to the original deterministic rule: last admitted.
+        None when nothing else is left to evict."""
+        best: Optional[Request] = None
+        for r in self.running:          # later index wins ties (stable max)
+            if r is not keep and (best is None
+                                  or r.deadline >= best.deadline):
+                best = r
+        return best
 
     def preempt(self, req: Request) -> None:
         """Evict by recompute: drop block refs, fold generated tokens
@@ -298,3 +309,23 @@ class Scheduler:
         self.running.remove(req)
         req.state = FINISHED
         req.finish_reason = reason
+
+    def cancel(self, req: Request) -> bool:
+        """Remove a request wherever it sits — the wait queue (no KV
+        held) or the running set (frees its blocks; shared prefix
+        blocks just drop one refcount and queued COW copies to freed
+        blocks are cancelled by free_sequence). Returns False when the
+        request already finished. Engine-thread only, BETWEEN steps: a
+        cancelled row must never reach an in-flight plan (the serve
+        front-end marshals client disconnects through the engine loop,
+        serve/frontend.py)."""
+        if req in self.running:
+            self.cache.free_sequence(req.req_id)
+            self.running.remove(req)
+        elif req in self.waiting:
+            self.waiting.remove(req)
+        else:
+            return False
+        req.state = FINISHED
+        req.finish_reason = "cancelled"
+        return True

@@ -8,7 +8,11 @@ The layer every serving subsystem reports through:
 - `tracing` — per-request lifecycle spans (queued -> prefill ->
   decode, preemption re-entries), exported as Chrome trace and
   mergeable with the host profiler timeline.
-- `http` — stdlib-only `/metrics` scrape server.
+- `http` — stdlib-only scrape server: `/metrics`, `/healthz`
+  (liveness), `/readyz` (readiness callback), mountable extra routes.
+- `slo` — SLOMonitor: objectives over the live registry, multi-window
+  burn rates, `/slo` verdict — what admission control and the replica
+  router consume.
 
 ServeEngine / Scheduler / PagedKVCache and the resilience runtime
 record into `default_registry()` unless constructed with an explicit
@@ -26,10 +30,13 @@ from paddle_tpu.obs.metrics import (
     log_buckets,
 )
 from paddle_tpu.obs.tracing import RequestTracer, merged_chrome_trace
-from paddle_tpu.obs.http import MetricsServer
+from paddle_tpu.obs.http import MetricsServer, json_route, obs_response
+from paddle_tpu.obs.slo import SLOMonitor, SLOObjective, default_objectives
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "Snapshotter", "default_registry", "log_buckets",
     "RequestTracer", "merged_chrome_trace", "MetricsServer",
+    "json_route", "obs_response",
+    "SLOMonitor", "SLOObjective", "default_objectives",
 ]

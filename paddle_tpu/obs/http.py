@@ -1,11 +1,21 @@
 """Stdlib-only HTTP scrape endpoint for the metrics registry.
 
 One small ThreadingHTTPServer (no third-party deps — the container
-rule) serving:
+rule) serving the observability surface every replica exposes:
 
-- `GET /metrics`  -> Prometheus text exposition 0.0.4 of the bound
+- `GET /metrics` -> Prometheus text exposition 0.0.4 of the bound
   registry (obs/metrics.py render_prometheus);
-- `GET /healthz`  -> `ok` (liveness for a replica router / k8s probe).
+- `GET /healthz` -> `ok` — pure LIVENESS: the process is up and can
+  answer a socket. Never consults engine state, so a draining or
+  still-compiling replica is alive, just not ready;
+- `GET /readyz` -> READINESS: 200 only when the bound `readiness`
+  callback says so (the serve front-end reports not-ready until the
+  engine's one compiled step is warm, and again once a drain begins),
+  503 with the reason in the body otherwise. Routers and k8s probes
+  gate on THIS one; a replica failing /readyz but passing /healthz is
+  cold or draining, not dead;
+- any extra mounted route (e.g. `/slo` -> the SLOMonitor verdict JSON,
+  obs/slo.py) via `routes={path: callable -> (status, ctype, body)}`.
 
 `port=0` binds an ephemeral port (read it back from `.port` — what
 tests use); the server runs on a daemon thread so it can never hold a
@@ -13,29 +23,77 @@ draining process open. A scrape renders under the registry locks
 child-by-child, so it is safe concurrent with the serve loop's
 recording — that is the point: pull-based exposition without pausing
 the engine.
+
+`obs_response()` is the routing logic factored out of the server so
+the serve front-end (serve/frontend.py), which multiplexes these paths
+with its own /v1/* API on ONE port, answers byte-identically to a
+standalone MetricsServer.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
+# (status, content-type, body)
+Response = Tuple[int, str, bytes]
+# readiness callback: (ready, reason) — reason lands in the 503 body
+Readiness = Callable[[], Tuple[bool, str]]
+
+
+def json_route(fn: Callable[[], dict]) -> Callable[[], Response]:
+    """Wrap a dict-producing callable (e.g. SLOMonitor.verdict) as a
+    mountable JSON route."""
+    def route() -> Response:
+        return 200, "application/json", (
+            json.dumps(fn()) + "\n").encode()
+    return route
+
+
+def obs_response(path: str, registry: MetricsRegistry,
+                 readiness: Optional[Readiness] = None,
+                 routes: Optional[Dict[str, Callable[[], Response]]] = None
+                 ) -> Optional[Response]:
+    """Answer one observability GET; None when the path is not ours
+    (the caller 404s or falls through to its own API)."""
+    path = path.split("?")[0]
+    if routes and path in routes:
+        return routes[path]()
+    if path == "/metrics":
+        return 200, CONTENT_TYPE, registry.render_prometheus().encode()
+    if path == "/healthz":
+        return 200, "text/plain", b"ok\n"
+    if path == "/readyz":
+        if readiness is None:
+            return 200, "text/plain", b"ready\n"
+        ready, reason = readiness()
+        if ready:
+            return 200, "text/plain", b"ready\n"
+        return 503, "text/plain", f"not ready: {reason}\n".encode()
+    return None
+
 
 class MetricsServer:
     """`with MetricsServer(registry, port=9090) as srv:` or
-    start()/stop(); `srv.url` is the scrape address."""
+    start()/stop(); `srv.url` is the scrape address. `readiness` gates
+    /readyz; `routes` mounts extra GET paths (e.g. /slo)."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 readiness: Optional[Readiness] = None,
+                 routes: Optional[Dict[str, Callable[[], Response]]] = None):
         self.registry = registry if registry is not None \
             else default_registry()
         self.host = host
         self.port = port
+        self.readiness = readiness
+        self.routes = dict(routes or {})
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -46,22 +104,17 @@ class MetricsServer:
     def start(self) -> "MetricsServer":
         if self._server is not None:
             return self
-        registry = self.registry
+        outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):                           # noqa: N802 (stdlib)
-                if self.path.split("?")[0] == "/metrics":
-                    body = registry.render_prometheus().encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
-                elif self.path == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain")
-                else:
-                    body = b"not found\n"
-                    self.send_response(404)
-                    self.send_header("Content-Type", "text/plain")
+                resp = obs_response(self.path, outer.registry,
+                                    outer.readiness, outer.routes)
+                if resp is None:
+                    resp = (404, "text/plain", b"not found\n")
+                status, ctype, body = resp
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

@@ -1,0 +1,28 @@
+"""HTTP/SSE serving front-end + multi-replica router (SERVING over the
+engine subsystem — the ROADMAP's "millions of users" story).
+
+- `sse` — server-sent-events framing + a stdlib streaming client
+  (what the router proxy, serve_bench and the tests consume with);
+- `frontend` — `ServeFrontend`: one HTTP port per replica serving
+  `POST /v1/completions` (SSE token streaming, client-disconnect
+  cancellation that frees KV blocks, per-request deadlines feeding the
+  scheduler's preemption choice), admission control shedding on SLO
+  burn (obs/slo.py), and the observability surface (`/metrics`,
+  `/healthz`, `/readyz`, `/slo`) on the same port; SIGTERM drains
+  in-flight streams to a bounded deadline and exits 75
+  (resilience/errors.py PREEMPT_EXIT_CODE) so replicas are
+  preemptible;
+- `router` — `Router`: spreads traffic across N replicas with
+  prefix-hash sticky routing (the shared-system-prompt hit rate
+  survives scale-out), ranking fallbacks by each replica's scraped
+  `ptpu_kv_hit_rate` / `ptpu_sched_queue_depth` gauges;
+- `replica` — CLI entry point (`python -m paddle_tpu.serve.replica`)
+  booting a model + engine + front-end in one process.
+"""
+
+from paddle_tpu.serve.frontend import ServeFrontend
+from paddle_tpu.serve.router import Router
+from paddle_tpu.serve.sse import (iter_sse, sse_event, stream_completion)
+
+__all__ = ["ServeFrontend", "Router", "sse_event", "iter_sse",
+           "stream_completion"]

@@ -1,0 +1,549 @@
+"""ServeFrontend: the HTTP/SSE serving front-end over ServeEngine.
+
+One process, one port, two planes:
+
+- DATA PLANE — `POST /v1/completions`: JSON body in, server-sent
+  events out (one frame per sampled token, a final done frame with the
+  finish reason + full token list, then `[DONE]`). Streaming falls out
+  of the engine's iteration-level scheduling: the engine thread runs
+  `step()` continuously and per-token callbacks fan tokens out to
+  per-request queues that HTTP handler threads drain. A client that
+  disconnects mid-stream cancels its request — the engine frees the
+  sequence's KV blocks (shared prefix blocks drop one refcount) and
+  the loss shows up as `requests{reason="cancelled"}`.
+- CONTROL PLANE — the same telemetry the engine records is what
+  admits, sheds, and drains: `/metrics` (Prometheus scrape),
+  `/healthz` (pure liveness), `/readyz` (503 until the one compiled
+  step is warm, 503 again once a drain begins — the router and k8s
+  probes stop routing here), `/slo` (the SLOMonitor's machine-readable
+  verdict). Admission control rejects with 503 while an SLO objective
+  BURNS (obs/slo.py multi-window burn rate over the live TTFT /
+  TPOT / queue-wait histograms) or the wait queue is full — every shed
+  is a labeled `ptpu_serve_sheds_total{reason=...}` increment, so
+  overload is observable from the same scrape that caused it.
+
+THREADING. The engine is single-threaded by design (compiled steps,
+host-side allocator bookkeeping). All engine mutation happens on ONE
+loop thread; HTTP handler threads only enqueue work (submissions,
+cancellations) onto thread-safe queues and block on their own token
+queue. The registry and SLO monitor are thread-safe, so scrapes and
+admission checks never touch the engine.
+
+PREEMPTIBILITY. SIGTERM (or `begin_drain()`) flips readiness off,
+sheds new work with reason="draining", lets every in-flight stream run
+to completion bounded by `drain_deadline_s` (stragglers past the
+deadline are cancelled and counted in
+`ptpu_serve_drain_cancelled_total`), then stops and reports exit code
+75 (resilience/errors.py PREEMPT_EXIT_CODE) — same contract as the
+training runtime, so a fleet scheduler can tell "drained clean, safe
+to reschedule" from "crashed".
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import select
+import signal
+import socket
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from paddle_tpu.engine.engine import ServeEngine
+from paddle_tpu.engine.scheduler import Request
+from paddle_tpu.obs.http import json_route, obs_response
+from paddle_tpu.obs.slo import SLOMonitor
+from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
+from paddle_tpu.serve.sse import DONE_SENTINEL, sse_event
+from paddle_tpu.utils.log import serve_event
+
+
+class _Stream:
+    """Plumbing for one in-flight completion: the engine thread feeds
+    `q`; the HTTP handler thread drains it. Items: ("token", int),
+    ("done", reason, tokens), ("error", message)."""
+
+    __slots__ = ("params", "q", "req", "streamed")
+
+    def __init__(self, params: dict):
+        self.params = params
+        self.q: "queue.Queue" = queue.Queue()
+        self.req: Optional[Request] = None
+        self.streamed = 0
+
+
+class ServeFrontend:
+    """`ServeFrontend(engine).start()` binds the port (`.port` after
+    start — port=0 is ephemeral), spawns the engine loop, and serves
+    until `stop()` / a drain completes. `slo=None` builds a monitor
+    with default objectives over the engine's registry."""
+
+    def __init__(self, engine: ServeEngine, host: str = "127.0.0.1",
+                 port: int = 0, slo: Optional[SLOMonitor] = None,
+                 slo_interval_s: float = 0.25,
+                 max_queue_depth: int = 64,
+                 drain_deadline_s: float = 30.0,
+                 default_max_new_tokens: int = 64,
+                 default_deadline_ms: Optional[float] = None,
+                 warmup: bool = True):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.obs = engine.obs
+        self.slo = slo if slo is not None else SLOMonitor(engine.obs)
+        self.slo_interval_s = slo_interval_s
+        self.max_queue_depth = max_queue_depth
+        self.drain_deadline_s = drain_deadline_s
+        self.default_max_new_tokens = default_max_new_tokens
+        self.default_deadline_ms = default_deadline_ms
+        self._warmup = warmup
+        self.exit_code: Optional[int] = None
+
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._engine_thread: Optional[threading.Thread] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._work = threading.Event()       # engine loop wake-up
+        self._stopped = threading.Event()    # engine loop exited
+        self._submit: "deque[_Stream]" = deque()
+        self._cancel: "deque[_Stream]" = deque()
+        self._active: Dict[int, _Stream] = {}    # req_id -> stream
+        self._lock = threading.Lock()
+        self._open_streams = 0               # HTTP handlers mid-write
+        self._draining = False
+        self._drain_started = 0.0
+        self._stop_requested = False
+        self._warm = False
+
+        m = self.obs
+        self._m_sheds = m.counter(
+            "ptpu_serve_sheds_total",
+            "Admission rejections (503) by cause",
+            labelnames=("reason",))
+        self._m_drain_cancelled = m.counter(
+            "ptpu_serve_drain_cancelled_total",
+            "In-flight streams cancelled at the drain deadline")
+        self._m_draining = m.gauge(
+            "ptpu_serve_draining", "1 while a drain is in progress")
+        self._m_ready = m.gauge(
+            "ptpu_serve_ready",
+            "1 when /readyz reports ready (warm and not draining)")
+        self._m_ready.set(0.0)
+
+    # -- readiness --------------------------------------------------------
+    def readiness(self):
+        """The /readyz truth: a replica is routable iff its one
+        compiled step is warm (ptpu_engine_compiles >= 1 — explicit
+        warmup() or real traffic both warm it) AND it is not
+        draining."""
+        if not (self._warm or self.engine._m_compiles.value >= 1.0):
+            return False, "engine cold (compiled step not warm)"
+        if self._draining:
+            return False, "draining"
+        return True, ""
+
+    def _set_ready_gauge(self) -> None:
+        self._m_ready.set(1.0 if self.readiness()[0] else 0.0)
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "ServeFrontend":
+        if self._server is not None:
+            return self
+        if self._warmup:
+            self.warmup()
+        self.slo.start(self.slo_interval_s)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.0: SSE bodies are close-delimited, no chunking
+            def do_GET(self):                       # noqa: N802
+                outer._handle_get(self)
+
+            def do_POST(self):                      # noqa: N802
+                outer._handle_post(self)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, daemon=True, name="ptpu-serve-engine")
+        self._engine_thread.start()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ptpu-serve-http")
+        self._serve_thread.start()
+        serve_event("serve_listening", host=self.host, port=self.port,
+                    url=self.url)
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def warmup(self) -> None:
+        """Run one tiny request through the engine so the single
+        compiled step is built BEFORE /readyz flips — a router never
+        sees a replica that would compile on its first real request.
+        The engine is single-threaded: once the loop thread is live,
+        the warmup request must ride it like any other submission
+        (stepping from this thread would race the loop)."""
+        if self._warm:
+            return
+        vocab = self.engine.model.vocab
+        if self._engine_thread is not None and self._engine_thread.is_alive():
+            stream = _Stream({
+                "prompt": [vocab - 1] * 2, "max_new_tokens": 2,
+                "temperature": 0.0, "top_k": 0, "seed": 0,
+                "eos_id": None, "deadline_ms": None})
+            self._submit.append(stream)
+            self._work.set()
+            while True:
+                item = stream.q.get(timeout=120)
+                if item[0] in ("done", "error"):
+                    break
+        else:
+            self.engine.generate([[vocab - 1] * 2], max_new_tokens=2)
+        self.engine.reset_stats()
+        # reset_stats zeroes gauges in place; restore the compile gauge
+        # from the jit cache — the compiled step really is warm, and
+        # /readyz gates on exactly this series
+        self.engine._m_compiles.set(self.engine._step_fn._cache_size())
+        self._warm = True
+        self._set_ready_gauge()
+
+    def install_signals(self) -> "ServeFrontend":
+        """SIGTERM/SIGINT -> drain (main thread only: CLI entry)."""
+        def _on_signal(signum, frame):
+            serve_event("serve_sigterm", signal=int(signum))
+            self.begin_drain()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_signal)
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting, finish what's in flight (bounded), exit 75.
+        Idempotent; safe from any thread (including a signal
+        handler — it only flips flags and an Event)."""
+        if self._draining:
+            return
+        self._draining = True
+        self._drain_started = time.monotonic()
+        self._m_draining.set(1.0)
+        self._set_ready_gauge()
+        self._stop_requested = True
+        self._work.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Block until the engine loop exits (drain complete or
+        stop()); returns the exit code (75 for a drain)."""
+        self._stopped.wait(timeout)
+        return self.exit_code
+
+    def stop(self) -> None:
+        """Immediate non-drain shutdown (tests): cancels in-flight work
+        and tears the server down without the preempt exit code."""
+        self._stop_requested = True
+        self._work.set()
+        self._stopped.wait(timeout=10)
+        self._teardown()
+
+    def _teardown(self) -> None:
+        self.slo.stop()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+            self._serve_thread = None
+
+    # -- engine loop ------------------------------------------------------
+    def _engine_loop(self) -> None:
+        eng = self.engine
+        try:
+            while True:
+                self._drain_control_queues()
+                progressed = False
+                if eng.scheduler.has_work():
+                    progressed = eng.step()
+                    self._flush_finished()
+                if self._draining:
+                    if self._drain_finished():
+                        break
+                elif self._stop_requested:
+                    self._abort_active("shutdown")
+                    break
+                if not progressed:
+                    self._work.wait(0.02)
+                    self._work.clear()
+        finally:
+            if self._draining:
+                self.exit_code = PREEMPT_EXIT_CODE
+                serve_event("serve_drained",
+                            drain_s=round(time.monotonic()
+                                          - self._drain_started, 3),
+                            exit_code=self.exit_code)
+            self._stopped.set()
+
+    def _drain_control_queues(self) -> None:
+        """Apply handler-thread intents on the engine thread: new
+        submissions, then cancellations (a disconnect may target a
+        request submitted moments ago)."""
+        while self._submit:
+            stream = self._submit.popleft()
+            p = stream.params
+            try:
+                req = self.engine.add_request(
+                    p["prompt"], max_new_tokens=p["max_new_tokens"],
+                    temperature=p["temperature"], top_k=p["top_k"],
+                    seed=p["seed"], eos_id=p["eos_id"],
+                    deadline_ms=p["deadline_ms"],
+                    callback=lambda tok, s=stream: s.q.put(("token", tok)))
+                stream.req = req
+                with self._lock:
+                    self._active[req.req_id] = stream
+            except Exception as e:       # bad prompt: surface as 400
+                stream.q.put(("error", str(e)))
+        while self._cancel:
+            stream = self._cancel.popleft()
+            if stream.req is not None:
+                self.engine.cancel(stream.req)
+                with self._lock:
+                    self._active.pop(stream.req.req_id, None)
+
+    def _flush_finished(self) -> None:
+        """Push done frames for requests the last step finished."""
+        with self._lock:
+            done = [(rid, s) for rid, s in self._active.items()
+                    if s.req is not None and s.req.finish_reason]
+            for rid, _ in done:
+                del self._active[rid]
+        for rid, s in done:
+            s.q.put(("done", s.req.finish_reason,
+                     ServeEngine._generated_of(s.req)))
+
+    def _drain_finished(self) -> bool:
+        """True once every in-flight stream completed (or the deadline
+        cancelled it) and no handler is still writing."""
+        deadline_hit = (time.monotonic() - self._drain_started
+                        > self.drain_deadline_s)
+        if deadline_hit:
+            self._abort_active("drain_deadline", count_drain=True)
+        with self._lock:
+            engine_idle = not self._active
+        return (engine_idle and not self.engine.scheduler.has_work()
+                and (self._open_streams == 0 or deadline_hit))
+
+    def _abort_active(self, reason: str, count_drain: bool = False) -> None:
+        with self._lock:
+            aborted = list(self._active.values())
+            self._active.clear()
+        for s in aborted:
+            if s.req is not None:
+                self.engine.cancel(s.req)
+                if count_drain:
+                    self._m_drain_cancelled.inc()
+            s.q.put(("done", "cancelled", []))
+
+    # -- HTTP handlers ----------------------------------------------------
+    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+        self._set_ready_gauge()     # traffic may have warmed the engine
+        resp = obs_response(h.path, self.obs, readiness=self.readiness,
+                            routes={"/slo": json_route(self.slo.verdict)})
+        if resp is None:
+            resp = (404, "text/plain", b"not found\n")
+        self._send(h, *resp)
+
+    @staticmethod
+    def _send(h: BaseHTTPRequestHandler, status: int, ctype: str,
+              body: bytes, extra_headers: Optional[dict] = None) -> None:
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            for k, v in (extra_headers or {}).items():
+                h.send_header(k, v)
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _shed(self, h: BaseHTTPRequestHandler, reason: str) -> None:
+        self._m_sheds.labels(reason=reason).inc()
+        serve_event("serve_shed", reason=reason,
+                    queue_depth=self.engine.scheduler.queue_depth)
+        body = json.dumps({"error": "overloaded", "reason": reason,
+                           "retry_after_s": 1.0}).encode() + b"\n"
+        self._send(h, 503, "application/json", body,
+                   {"Retry-After": "1"})
+
+    def _admission_shed_reason(self) -> Optional[str]:
+        """Why a new request must bounce, or None to admit. Order
+        matters: a draining replica sheds everything; a full queue is
+        backpressure regardless of SLO state; then the SLO verdict."""
+        if self._draining or self._stop_requested:
+            return "draining"
+        if self.engine.scheduler.queue_depth >= self.max_queue_depth:
+            return "queue_full"
+        burning = self.slo.burning_objectives()
+        if burning:
+            return f"slo_{burning[0]}"
+        return None
+
+    def _parse_completion(self, h: BaseHTTPRequestHandler
+                          ) -> Optional[dict]:
+        try:
+            length = int(h.headers.get("Content-Length", "0"))
+            body = json.loads(h.rfile.read(length) or b"{}")
+            prompt = body["prompt"]
+            if (not isinstance(prompt, list)
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise ValueError("prompt must be a list of token ids")
+            return {
+                "prompt": prompt,
+                "max_new_tokens": int(body.get(
+                    "max_new_tokens", self.default_max_new_tokens)),
+                "temperature": float(body.get("temperature", 0.0)),
+                "top_k": int(body.get("top_k", 0)),
+                "seed": int(body.get("seed", 0)),
+                "eos_id": body.get("eos_id"),
+                "deadline_ms": body.get("deadline_ms",
+                                        self.default_deadline_ms),
+                "stream": bool(body.get("stream", True)),
+            }
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(h, 400, "application/json",
+                       json.dumps({"error": str(e)}).encode() + b"\n")
+            return None
+
+    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
+        if h.path.split("?")[0] != "/v1/completions":
+            self._send(h, 404, "text/plain", b"not found\n")
+            return
+        params = self._parse_completion(h)
+        if params is None:
+            return
+        reason = self._admission_shed_reason()
+        if reason is not None:
+            self._shed(h, reason)
+            return
+        stream = _Stream(params)
+        with self._lock:
+            self._open_streams += 1
+        try:
+            self._submit.append(stream)
+            self._work.set()
+            if params["stream"]:
+                self._stream_response(h, stream)
+            else:
+                self._aggregate_response(h, stream)
+        finally:
+            with self._lock:
+                self._open_streams -= 1
+
+    def _stream_timeout(self, params: dict) -> float:
+        """Worst-case seconds to wait for the next queue item before
+        declaring the engine wedged."""
+        if params["deadline_ms"] is not None:
+            return max(params["deadline_ms"] / 1e3 * 4, 30.0)
+        return 300.0
+
+    @staticmethod
+    def _client_gone(h: BaseHTTPRequestHandler) -> bool:
+        """Peek the client socket for EOF/RST — an SSE client sends
+        nothing after its request, so readability means it hung up.
+        This catches a disconnect even while the stream is between
+        tokens (a write would only fail on the NEXT token)."""
+        try:
+            r, _, _ = select.select([h.connection], [], [], 0)
+            if not r:
+                return False
+            return h.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _stream_response(self, h: BaseHTTPRequestHandler,
+                         stream: _Stream) -> None:
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", "text/event-stream")
+            h.send_header("Cache-Control", "no-cache")
+            h.end_headers()
+        except (BrokenPipeError, ConnectionResetError):
+            self._request_cancel(stream)
+            return
+        deadline = time.monotonic() + self._stream_timeout(stream.params)
+        while True:
+            try:
+                item = stream.q.get(timeout=0.05)
+            except queue.Empty:
+                if self._client_gone(h):
+                    self._request_cancel(stream)
+                    return
+                if time.monotonic() > deadline:
+                    self._request_cancel(stream)
+                    return
+                continue
+            try:
+                if item[0] == "token":
+                    h.wfile.write(sse_event(
+                        {"token": item[1], "index": stream.streamed}))
+                    h.wfile.flush()
+                    stream.streamed += 1
+                elif item[0] == "done":
+                    _, reason, tokens = item
+                    h.wfile.write(sse_event(
+                        {"done": True, "reason": reason,
+                         "tokens": tokens,
+                         "req_id": stream.req.req_id
+                         if stream.req else None}))
+                    h.wfile.write(sse_event(DONE_SENTINEL))
+                    h.wfile.flush()
+                    return
+                else:                              # ("error", msg)
+                    h.wfile.write(sse_event(
+                        {"error": item[1], "done": True,
+                         "reason": "error"}))
+                    h.wfile.write(sse_event(DONE_SENTINEL))
+                    h.wfile.flush()
+                    return
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # client went away mid-stream: free its KV now
+                self._request_cancel(stream)
+                return
+
+    def _aggregate_response(self, h: BaseHTTPRequestHandler,
+                            stream: _Stream) -> None:
+        tokens: List[int] = []
+        timeout = self._stream_timeout(stream.params)
+        while True:
+            try:
+                item = stream.q.get(timeout=timeout)
+            except queue.Empty:
+                self._request_cancel(stream)
+                self._send(h, 504, "application/json",
+                           b'{"error": "timed out"}\n')
+                return
+            if item[0] == "token":
+                tokens.append(item[1])
+            elif item[0] == "done":
+                _, reason, full = item
+                body = json.dumps({
+                    "tokens": full or tokens, "reason": reason,
+                    "req_id": stream.req.req_id if stream.req else None,
+                }).encode() + b"\n"
+                self._send(h, 200, "application/json", body)
+                return
+            else:
+                self._send(h, 400, "application/json",
+                           json.dumps({"error": item[1]}).encode() + b"\n")
+                return
+
+    def _request_cancel(self, stream: _Stream) -> None:
+        self._cancel.append(stream)
+        self._work.set()

@@ -1,0 +1,127 @@
+"""Replica CLI: one serving process = model + engine + front-end.
+
+`python -m paddle_tpu.serve.replica --port 0 ...` boots a CausalLM
+(either a fresh PRNGKey(--init-seed) init — every replica started with
+the same seed and dims holds IDENTICAL weights, which is how
+serve_bench and the tests stand up a homogeneous fleet without a
+checkpoint — or `--model-dir` from a save_inference_model() export),
+wraps it in a ServeEngine and a ServeFrontend, warms the one compiled
+step, and prints a single `serve_listening` JSON line carrying the
+bound port (ephemeral with --port 0) for the parent to read back.
+
+SIGTERM drains: in-flight streams finish (bounded by
+--drain-deadline-s), then the process exits 75 (PREEMPT_EXIT_CODE) —
+the same "safe to reschedule" contract as the training runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="ptpu serve replica")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 binds an ephemeral port (printed in the "
+                        "serve_listening line)")
+    # model: a saved export, or a fresh deterministic init
+    p.add_argument("--model-dir", default=None,
+                   help="save_inference_model() directory with serve "
+                        "metadata; omitting it builds a fresh model")
+    p.add_argument("--vocab", type=int, default=61)
+    p.add_argument("--model-dim", type=int, default=16)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--ffn-dim", type=int, default=32)
+    p.add_argument("--max-len", type=int, default=64)
+    p.add_argument("--init-seed", type=int, default=0,
+                   help="PRNGKey seed for the fresh init: same seed + "
+                        "dims = identical weights on every replica")
+    # engine
+    p.add_argument("--max-batch-size", type=int, default=4)
+    p.add_argument("--block-size", type=int, default=4)
+    p.add_argument("--num-blocks", type=int, default=64)
+    p.add_argument("--max-prefill-tokens", type=int, default=64)
+    p.add_argument("--tile-q", type=int, default=8)
+    p.add_argument("--no-prefix-cache", action="store_true")
+    # front-end / admission / drain
+    p.add_argument("--max-queue-depth", type=int, default=64)
+    p.add_argument("--drain-deadline-s", type=float, default=30.0)
+    p.add_argument("--default-max-new-tokens", type=int, default=32)
+    p.add_argument("--default-deadline-ms", type=float, default=None)
+    # SLO objectives (obs/slo.py default_objectives)
+    p.add_argument("--slo-ttft-ms", type=float, default=500.0)
+    p.add_argument("--slo-tpot-ms", type=float, default=200.0)
+    p.add_argument("--slo-queue-wait-ms", type=float, default=1000.0)
+    p.add_argument("--slo-target", type=float, default=0.99)
+    p.add_argument("--slo-short-window-s", type=float, default=5.0)
+    p.add_argument("--slo-long-window-s", type=float, default=60.0)
+    p.add_argument("--slo-burn-threshold", type=float, default=1.0)
+    p.add_argument("--slo-min-samples", type=int, default=4)
+    p.add_argument("--slo-interval-s", type=float, default=0.25)
+    return p
+
+
+def build_frontend(a: argparse.Namespace):
+    """Everything up to (not including) start(): importable by tests
+    that want an in-process replica with CLI-identical wiring."""
+    from paddle_tpu.engine.engine import ServeEngine
+    from paddle_tpu.obs.metrics import MetricsRegistry
+    from paddle_tpu.obs.slo import SLOMonitor, default_objectives
+    from paddle_tpu.serve.frontend import ServeFrontend
+
+    registry = MetricsRegistry()    # private: one process, one story
+    if a.model_dir:
+        engine = ServeEngine.from_saved_model(
+            a.model_dir, max_batch_size=a.max_batch_size,
+            block_size=a.block_size, num_blocks=a.num_blocks,
+            max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
+            enable_prefix_cache=not a.no_prefix_cache, registry=registry)
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models.transformer import CausalLM
+
+        model = CausalLM(vocab=a.vocab, model_dim=a.model_dim,
+                         num_heads=a.num_heads, num_layers=a.num_layers,
+                         ffn_dim=a.ffn_dim, dropout=0.0, max_len=a.max_len)
+        variables = model.init(jax.random.PRNGKey(a.init_seed),
+                               jnp.zeros((1, 4), jnp.int32))
+        engine = ServeEngine(
+            model, variables, max_batch_size=a.max_batch_size,
+            block_size=a.block_size, num_blocks=a.num_blocks,
+            max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
+            enable_prefix_cache=not a.no_prefix_cache, registry=registry)
+    slo = SLOMonitor(
+        registry,
+        objectives=default_objectives(
+            ttft_ms=a.slo_ttft_ms, tpot_ms=a.slo_tpot_ms,
+            queue_wait_ms=a.slo_queue_wait_ms, target=a.slo_target),
+        short_window_s=a.slo_short_window_s,
+        long_window_s=a.slo_long_window_s,
+        burn_threshold=a.slo_burn_threshold,
+        min_samples=a.slo_min_samples)
+    return ServeFrontend(
+        engine, host=a.host, port=a.port, slo=slo,
+        slo_interval_s=a.slo_interval_s,
+        max_queue_depth=a.max_queue_depth,
+        drain_deadline_s=a.drain_deadline_s,
+        default_max_new_tokens=a.default_max_new_tokens,
+        default_deadline_ms=a.default_deadline_ms)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    a = build_parser().parse_args(argv)
+    frontend = build_frontend(a)
+    frontend.start().install_signals()
+    code = frontend.wait()      # blocks until a drain completes
+    frontend._teardown()
+    return code if code is not None else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,422 @@
+"""Router: one front door over N serve replicas.
+
+Scale-out story (ROADMAP "serve millions"): each replica is a
+ServeFrontend process with its own engine, KV pool and telemetry; the
+router is a thin streaming proxy that decides WHICH replica sees a
+request and otherwise copies bytes. Three decisions, all driven by the
+replicas' own scraped telemetry — the router holds no model state:
+
+- STICKY PREFIX ROUTING. Prefix caching only pays when requests that
+  share a prompt prefix land on the SAME replica (each engine's block
+  pool is private). The primary replica is a stable hash — crc32, not
+  Python's per-process-salted `hash()` — of the first `prefix_len`
+  prompt tokens, modulo N: every request with the same system prompt
+  hashes to the same replica, so the fleet-wide hit rate tracks the
+  single-replica hit rate instead of decaying ~1/N (serve_bench's
+  router scenario measures exactly this).
+- TELEMETRY-RANKED FALLBACK. When the primary is not routable (failed
+  /readyz: cold or draining; scrape failure; or it sheds 503), the
+  request falls back to the remaining ready replicas ranked by their
+  scraped `ptpu_kv_hit_rate` (desc — a warm cache serves a prefix
+  cheapest) then `ptpu_sched_queue_depth` (asc — shortest line). The
+  scrape loop refreshes each replica's gauges every
+  `scrape_interval_s` on a daemon thread.
+- DRAIN, SAME CONTRACT AS REPLICAS. SIGTERM stops admission (503
+  reason="draining"), lets in-flight proxied streams finish to a
+  bounded deadline, and exits PREEMPT_EXIT_CODE (75) — a router is as
+  preemptible as the replicas behind it.
+
+The proxy relays the replica's SSE byte stream unbuffered, so the
+`[DONE]` untruncated-stream invariant survives the extra hop, and a
+client disconnect propagates: the router's write fails, it drops the
+replica connection, the replica's write fails, the engine cancels and
+frees KV blocks.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+import zlib
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import urlsplit
+
+from paddle_tpu.obs.http import obs_response
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
+from paddle_tpu.serve.sse import parse_prometheus_values
+from paddle_tpu.utils.log import serve_event
+
+
+def prefix_shard(prompt: Sequence[int], n: int, prefix_len: int = 32) -> int:
+    """Stable shard index for a prompt: crc32 over the first
+    `prefix_len` token ids (little-endian u32 each) mod n. Identical
+    prefixes -> identical replica, across processes and runs."""
+    head = list(prompt[:prefix_len])
+    raw = b"".join(int(t & 0xFFFFFFFF).to_bytes(4, "little") for t in head)
+    return zlib.crc32(raw) % max(n, 1)
+
+
+class ReplicaState:
+    """What the scrape loop knows about one replica right now."""
+
+    __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
+                 "queue_depth", "last_scrape")
+
+    def __init__(self, url: str):
+        parts = urlsplit(url)
+        self.url = url.rstrip("/")
+        self.host = parts.hostname
+        self.port = parts.port or 80
+        self.ready = False
+        self.reason = "never scraped"
+        self.hit_rate = 0.0
+        self.queue_depth = 0.0
+        self.last_scrape = 0.0
+
+
+class Router:
+    """`Router(["http://h:p1", "http://h:p2"]).start()` binds `.port`
+    and proxies `/v1/completions`; `/metrics`, `/healthz`, `/readyz`
+    describe the router itself (ready iff >=1 replica is ready)."""
+
+    def __init__(self, replica_urls: Sequence[str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 prefix_len: int = 32,
+                 scrape_interval_s: float = 0.5,
+                 drain_deadline_s: float = 30.0,
+                 connect_timeout_s: float = 10.0):
+        if not replica_urls:
+            raise ValueError("router needs at least one replica url")
+        self.replicas = [ReplicaState(u) for u in replica_urls]
+        self.host = host
+        self.port = port
+        self.prefix_len = prefix_len
+        self.scrape_interval_s = scrape_interval_s
+        self.drain_deadline_s = drain_deadline_s
+        self.connect_timeout_s = connect_timeout_s
+        self.exit_code: Optional[int] = None
+
+        self.obs = MetricsRegistry()    # the router's OWN process story
+        self._m_routed = self.obs.counter(
+            "ptpu_router_requests_total",
+            "Requests proxied, by replica and route kind",
+            labelnames=("replica", "kind"))     # kind=primary|fallback
+        self._m_sheds = self.obs.counter(
+            "ptpu_router_sheds_total",
+            "Requests the router itself bounced (503)",
+            labelnames=("reason",))     # reason=draining|no_replica
+        self._m_replica_ready = self.obs.gauge(
+            "ptpu_router_replica_ready", "1 when the replica passes /readyz",
+            labelnames=("replica",))
+        self._m_replica_hit = self.obs.gauge(
+            "ptpu_router_replica_hit_rate",
+            "Replica's scraped ptpu_kv_hit_rate", labelnames=("replica",))
+        self._m_replica_depth = self.obs.gauge(
+            "ptpu_router_replica_queue_depth",
+            "Replica's scraped ptpu_sched_queue_depth",
+            labelnames=("replica",))
+        self._m_inflight = self.obs.gauge(
+            "ptpu_router_inflight", "Streams currently being proxied")
+        self._m_draining = self.obs.gauge(
+            "ptpu_router_draining", "1 while the router drains")
+
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._scrape_thread: Optional[threading.Thread] = None
+        self._stop_scrape = threading.Event()
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._draining = False
+        self._drained = threading.Event()
+
+    # -- scrape loop ------------------------------------------------------
+    def _scrape_once(self, r: ReplicaState) -> None:
+        try:
+            conn = HTTPConnection(r.host, r.port,
+                                  timeout=self.connect_timeout_s)
+            try:
+                conn.request("GET", "/readyz")
+                resp = conn.getresponse()
+                body = resp.read().decode("utf-8", "replace").strip()
+                r.ready = resp.status == 200
+                r.reason = "" if r.ready else body
+                conn.request("GET", "/metrics")
+                resp = conn.getresponse()
+                text = resp.read().decode("utf-8", "replace")
+            finally:
+                conn.close()
+            vals = parse_prometheus_values(text)
+            r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
+            r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
+            r.last_scrape = time.monotonic()
+        except OSError as e:
+            r.ready = False
+            r.reason = f"scrape failed: {e}"
+        self._m_replica_ready.labels(replica=r.url).set(
+            1.0 if r.ready else 0.0)
+        self._m_replica_hit.labels(replica=r.url).set(r.hit_rate)
+        self._m_replica_depth.labels(replica=r.url).set(r.queue_depth)
+
+    def scrape_now(self) -> None:
+        """One synchronous pass over every replica (startup, tests)."""
+        for r in self.replicas:
+            self._scrape_once(r)
+
+    def _scrape_loop(self) -> None:
+        while not self._stop_scrape.wait(self.scrape_interval_s):
+            self.scrape_now()
+
+    # -- routing policy ---------------------------------------------------
+    def plan_route(self, prompt: Sequence[int]) -> List[ReplicaState]:
+        """Candidate replicas in try-order: the sticky prefix-hash
+        primary first (even when it looks not-ready the scrape may be
+        stale — a 503 there falls through), then every OTHER ready
+        replica ranked best-fallback-first: highest scraped hit rate,
+        then shortest queue."""
+        primary = self.replicas[prefix_shard(prompt, len(self.replicas),
+                                             self.prefix_len)]
+        fallbacks = sorted(
+            (r for r in self.replicas if r is not primary and r.ready),
+            key=lambda r: (-r.hit_rate, r.queue_depth))
+        if primary.ready:
+            return [primary] + fallbacks
+        return fallbacks + [primary]    # last-ditch: maybe stale scrape
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Router":
+        if self._server is not None:
+            return self
+        self.scrape_now()
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, daemon=True, name="ptpu-router-scrape")
+        self._scrape_thread.start()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):                       # noqa: N802
+                outer._handle_get(self)
+
+            def do_POST(self):                      # noqa: N802
+                outer._handle_post(self)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ptpu-router-http")
+        self._serve_thread.start()
+        serve_event("router_listening", host=self.host, port=self.port,
+                    replicas=[r.url for r in self.replicas])
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def install_signals(self) -> "Router":
+        def _on_signal(signum, frame):
+            serve_event("router_sigterm", signal=int(signum))
+            threading.Thread(target=self.begin_drain, daemon=True).start()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, _on_signal)
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admitting; wait for in-flight proxied streams to finish
+        (bounded by drain_deadline_s); record exit code 75."""
+        with self._lock:
+            if self._draining:
+                return
+            self._draining = True
+        self._m_draining.set(1.0)
+        deadline = time.monotonic() + self.drain_deadline_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.05)
+        self.exit_code = PREEMPT_EXIT_CODE
+        serve_event("router_drained", exit_code=self.exit_code,
+                    inflight_at_exit=self._inflight)
+        self._drained.set()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[int]:
+        self._drained.wait(timeout)
+        return self.exit_code
+
+    def stop(self) -> None:
+        self._stop_scrape.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5)
+            self._serve_thread = None
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5)
+            self._scrape_thread = None
+
+    # -- HTTP -------------------------------------------------------------
+    def readiness(self) -> Tuple[bool, str]:
+        if self._draining:
+            return False, "draining"
+        if any(r.ready for r in self.replicas):
+            return True, ""
+        return False, "no ready replicas"
+
+    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+        resp = obs_response(h.path, self.obs, readiness=self.readiness)
+        if resp is None:
+            resp = (404, "text/plain", b"not found\n")
+        status, ctype, body = resp
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _shed(self, h: BaseHTTPRequestHandler, reason: str) -> None:
+        self._m_sheds.labels(reason=reason).inc()
+        body = json.dumps({"error": "overloaded", "reason": reason,
+                           "retry_after_s": 1.0}).encode() + b"\n"
+        try:
+            h.send_response(503)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(body)))
+            h.send_header("Retry-After", "1")
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
+        if h.path.split("?")[0] != "/v1/completions":
+            self._handle_get(h)         # reuse the 404 path
+            return
+        if self._draining:
+            self._shed(h, "draining")
+            return
+        try:
+            length = int(h.headers.get("Content-Length", "0"))
+            raw = h.rfile.read(length)
+            prompt = json.loads(raw or b"{}").get("prompt") or []
+        except (ValueError, json.JSONDecodeError):
+            raw, prompt = b"{}", []
+        candidates = self.plan_route(prompt)
+        if not candidates:
+            self._shed(h, "no_replica")
+            return
+        with self._lock:
+            self._inflight += 1
+        self._m_inflight.set(self._inflight)
+        try:
+            self._proxy(h, raw, prompt, candidates)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+
+    def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
+               prompt: Sequence[int],
+               candidates: List[ReplicaState]) -> None:
+        """Try candidates in order; a refused connection or a 503 shed
+        moves to the next. The first streamable response is relayed
+        byte-for-byte (SSE frames pass through untouched)."""
+        sticky = self.replicas[prefix_shard(prompt, len(self.replicas),
+                                            self.prefix_len)]
+        last_resp: Optional[Tuple[int, bytes]] = None
+        for r in candidates:
+            try:
+                conn = HTTPConnection(r.host, r.port,
+                                      timeout=self.connect_timeout_s)
+                conn.request(
+                    "POST", "/v1/completions", body=raw,
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except OSError:
+                r.ready = False
+                r.reason = "connect failed"
+                continue
+            if resp.status == 503:      # replica shed: try the next
+                last_resp = (503, resp.read())
+                conn.close()
+                continue
+            kind = "primary" if r is sticky else "fallback"
+            self._m_routed.labels(replica=r.url, kind=kind).inc()
+            self._relay(h, resp)
+            conn.close()
+            return
+        if last_resp is not None:       # every replica shed: relay it
+            status, body = last_resp
+            try:
+                h.send_response(status)
+                h.send_header("Content-Type", "application/json")
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            return
+        self._shed(h, "no_replica")
+
+    @staticmethod
+    def _relay(h: BaseHTTPRequestHandler, resp) -> None:
+        """Copy status + content-type + body bytes to the client,
+        unbuffered per read so tokens stream as they arrive. A client
+        write failure closes the replica socket (via the caller's
+        conn.close()), which cancels the request engine-side."""
+        try:
+            h.send_response(resp.status)
+            ctype = resp.getheader("Content-Type", "application/octet-stream")
+            h.send_header("Content-Type", ctype)
+            h.end_headers()
+            while True:
+                chunk = resp.read1(8192) if hasattr(resp, "read1") \
+                    else resp.read(8192)
+                if not chunk:
+                    break
+                h.wfile.write(chunk)
+                h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m paddle_tpu.serve.router --replica URL --replica URL`"""
+    import argparse
+
+    p = argparse.ArgumentParser(description="ptpu serve router")
+    p.add_argument("--replica", action="append", required=True,
+                   help="replica base url (repeatable)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--prefix-len", type=int, default=32)
+    p.add_argument("--scrape-interval-s", type=float, default=0.5)
+    p.add_argument("--drain-deadline-s", type=float, default=30.0)
+    a = p.parse_args(argv)
+    router = Router(a.replica, host=a.host, port=a.port,
+                    prefix_len=a.prefix_len,
+                    scrape_interval_s=a.scrape_interval_s,
+                    drain_deadline_s=a.drain_deadline_s)
+    router.start().install_signals()
+    code = router.wait()
+    router.stop()
+    return code if code is not None else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

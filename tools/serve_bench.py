@@ -1,9 +1,11 @@
 """Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
-Four scenarios, each an acceptance property of the engine subsystem
-(ENGINE.md), each verified on the SAME model with EXACT token identity
-(greedy decode — the engine's batching/sharing/chunking invariance
-makes identity, not closeness, the bar):
+Five scenarios, each an acceptance property of the serving stack
+(ENGINE.md / OBSERVABILITY.md). The first four run in-process on the
+SAME model with EXACT token identity (greedy decode — the engine's
+batching/sharing/chunking invariance makes identity, not closeness,
+the bar); the fifth stands up real replica PROCESSES and drives them
+over HTTP:
 
 - batch:   continuous batching must beat one-request-at-a-time decode
            on throughput (weight passes amortized over the batch).
@@ -25,6 +27,18 @@ makes identity, not closeness, the bar):
            scenario, so the latency bounds double as the
            observability-overhead guard: instrumentation that slowed
            the hot path would blow the same verdicts.
+- router:  the end-to-end scale-out story (serve/). Boots replica
+           subprocesses (`python -m paddle_tpu.serve.replica`) with
+           identical weights and a Router over them, then gates three
+           verdicts on SCRAPED /metrics — (a) prefix-hash sticky
+           routing holds the 2-replica fleet hit rate within 5% of a
+           single replica's on shared-system-prompt traffic, with
+           byte-identical tokens; (b) SIGTERM of one replica drains
+           every in-flight stream to `[DONE]` with zero token loss,
+           exits 75, and traffic fails over to the survivor; (c) SLO
+           admission control sheds nothing at nominal load, sheds
+           nonzero (reason slo_*) under 2x overload, and keeps the
+           admitted p99 TTFT under the configured deadline.
 
 Verdict inputs come from the metrics REGISTRY (paddle_tpu/obs/) — the
 same TTFT/TPOT/hit-rate/step-latency series a production scrape reads
@@ -39,23 +53,35 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 
 Exit code: 0 iff every scenario's verdict holds.
 
-Run: python tools/serve_bench.py [--scenario all|batch|prefix|chunked|mixed]
+Run: python tools/serve_bench.py
+     [--scenario all|batch|prefix|chunked|mixed|router]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
+     [--trace-out FILE]     # dump the last in-process verdict engine's
+                            # request-lifecycle Chrome trace
+                            # (chrome://tracing / perfetto)
 """
 
 import argparse
 import json
+import os
+import re
+import subprocess
 import sys
+import threading
 import time
 
 import _bootstrap  # noqa: F401  (repo path + cpu override)
 
 import numpy as np
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 # exposition of the most recent scenario's verdict engine; --metrics-out
 # writes it at end of run (the mixed scenario's when it ran)
 LAST_EXPOSITION = ""
+# that engine's RequestTracer; --trace-out dumps its Chrome trace
+LAST_TRACER = None
 
 
 def emit(obj):
@@ -117,7 +143,7 @@ def serve_turns(eng, prompts, new_tokens):
 # -- scenario: continuous batching vs sequential ---------------------------
 
 def scenario_batch(model, variables, args):
-    global LAST_EXPOSITION
+    global LAST_EXPOSITION, LAST_TRACER
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, args.vocab,
                             rng.integers(4, args.prompt_len + 1)).tolist()
@@ -148,6 +174,7 @@ def scenario_batch(model, variables, args):
         cells[name + "_outs"] = outs
         emit(cells[name])
         LAST_EXPOSITION = eng.metrics_text()
+        LAST_TRACER = eng.tracer
     identical = cells["batched_outs"] == cells["sequential_outs"]
     faster = cells["batched"]["tok_s"] > cells["sequential"]["tok_s"]
     ok = bool(faster and identical)
@@ -161,7 +188,7 @@ def scenario_batch(model, variables, args):
 # -- scenario: shared system prompt, prefix cache on vs off ----------------
 
 def scenario_prefix(model, variables, args):
-    global LAST_EXPOSITION
+    global LAST_EXPOSITION, LAST_TRACER
     rng = np.random.default_rng(1)
     system = rng.integers(0, args.vocab - 1, args.system_len).tolist()
     prompts = [system + rng.integers(0, args.vocab - 1,
@@ -203,6 +230,7 @@ def scenario_prefix(model, variables, args):
         emit(results[name])
         eng.cache.assert_quiesced()
         LAST_EXPOSITION = eng.metrics_text()
+        LAST_TRACER = eng.tracer
     shared, base = results["prefix_shared"], results["prefix_baseline"]
     identical = results["prefix_shared_outs"] == results[
         "prefix_baseline_outs"]
@@ -257,7 +285,7 @@ def _run_chunked_cell(model, variables, args, budget):
 
 
 def scenario_chunked(model, variables, args):
-    global LAST_EXPOSITION
+    global LAST_EXPOSITION, LAST_TRACER
     mono, mono_outs, _ = _run_chunked_cell(model, variables, args,
                                            budget=args.max_len)
     emit(mono)
@@ -265,6 +293,7 @@ def scenario_chunked(model, variables, args):
                                                budget=args.chunk_tokens)
     emit(chunk)
     LAST_EXPOSITION = eng.metrics_text()
+    LAST_TRACER = eng.tracer
     identical = chunk_outs == mono_outs
     ok = bool(identical
               and chunk["max_step_ms"] < mono["max_step_ms"]
@@ -343,7 +372,7 @@ def _run_mixed_cell(model, variables, args, budget):
 
 
 def scenario_mixed(model, variables, args):
-    global LAST_EXPOSITION
+    global LAST_EXPOSITION, LAST_TRACER
     mono, mono_outs, _ = _run_mixed_cell(model, variables, args,
                                          budget=args.max_len)
     emit(mono)
@@ -351,6 +380,7 @@ def scenario_mixed(model, variables, args):
                                              budget=args.chunk_tokens)
     emit(mixed)
     checks, LAST_EXPOSITION = _exposition_complete(eng)
+    LAST_TRACER = eng.tracer
     identical = mixed_outs == mono_outs
     # max-step bound with metrics ON is the observability-overhead
     # guard: instrumentation that slowed the one-compile hot path
@@ -372,11 +402,351 @@ def scenario_mixed(model, variables, args):
     return ok
 
 
+# -- scenario: router — multi-replica scale-out over real processes --------
+
+# the replica CLI's default model (vocab 61, dim 16) boots in seconds;
+# every replica inits from the same seed so the fleet holds identical
+# weights and greedy decode is byte-identical across replicas
+_REPLICA_VOCAB = 61
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def _spawn_replica(extra=()):
+    """Boot `python -m paddle_tpu.serve.replica --port 0` and block
+    until its serve_listening line yields the bound port. Returns
+    (Popen, base_url); stdout is drained by a daemon thread afterwards
+    so serve-event chatter can never fill the pipe and wedge the
+    replica."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serve.replica",
+         "--port", "0", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, text=True, cwd=REPO_ROOT)
+    port = None
+    for line in proc.stdout:
+        try:
+            evt = json.loads(line)
+        except ValueError:
+            continue
+        if evt.get("evt") == "serve_listening":
+            port = evt["port"]
+            break
+    if not port:
+        proc.kill()
+        proc.wait()
+        raise RuntimeError("replica never printed serve_listening")
+    threading.Thread(target=proc.stdout.read, daemon=True).start()
+    return proc, f"http://127.0.0.1:{port}"
+
+
+def _terminate(proc):
+    """SIGTERM (drain) if still alive; returns the exit code."""
+    if proc.poll() is None:
+        proc.terminate()
+    try:
+        return proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait()
+
+
+def _scrape(base_url):
+    from paddle_tpu.serve.sse import http_get, parse_prometheus_values
+
+    return parse_prometheus_values(http_get(base_url + "/metrics")[1])
+
+
+def _scraped_hit_rate(scrapes):
+    """Fleet-wide prefix hit rate from scraped counters, aggregated
+    across replicas: sum(hit tokens) / sum(prompt tokens)."""
+    hit = sum(v.get("ptpu_kv_hit_tokens_total", 0.0) for v in scrapes)
+    total = sum(v.get("ptpu_kv_prompt_tokens_total", 0.0) for v in scrapes)
+    return hit / total if total else 0.0
+
+
+def _scraped_quantile(vals, family, q):
+    """histogram_quantile over a flat scrape dict: sums the cumulative
+    bucket counts across labelled children, returns the smallest
+    bucket bound covering the q-rank (inf when the rank lands in +Inf
+    — which any deadline comparison then fails, conservatively)."""
+    per_le = {}
+    prefix = family + "_bucket{"
+    for key, v in vals.items():
+        if not key.startswith(prefix):
+            continue
+        m = _LE_RE.search(key)
+        if not m:
+            continue
+        le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+        per_le[le] = per_le.get(le, 0.0) + v
+    if not per_le:
+        return float("nan")
+    bounds = sorted(per_le)
+    total = per_le[bounds[-1]]
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    for le in bounds:
+        if per_le[le] >= rank:
+            return le
+    return float("inf")
+
+
+def _shed_counts(vals):
+    """(total sheds, slo-reason sheds) from a replica scrape."""
+    total = slo = 0.0
+    for key, v in vals.items():
+        if key.startswith("ptpu_serve_sheds_total"):
+            total += v
+            if 'reason="slo_' in key:
+                slo += v
+    return total, slo
+
+
+def _phase_sticky(args, router, reqs):
+    """Drive the shared-prefix request set through the router, then
+    through a single fresh replica, and compare the fleet hit rate
+    (scraped KV counters) and the token streams."""
+    from paddle_tpu.serve.sse import collect_stream
+
+    t0 = time.perf_counter()
+    routed_outs = [collect_stream(router.url,
+                                  {"prompt": p,
+                                   "max_new_tokens": args.router_new_tokens})
+                   for p in reqs]
+    routed_wall = time.perf_counter() - t0
+    routed_rate = _scraped_hit_rate([_scrape(r.url)
+                                     for r in router.replicas])
+    fam = router.obs.get("ptpu_router_requests_total")
+    primary = sum(fam.labels(replica=r.url, kind="primary").value
+                  for r in router.replicas)
+    fallback = sum(fam.labels(replica=r.url, kind="fallback").value
+                   for r in router.replicas)
+    emit({"cell": "router_sticky", "requests": len(reqs),
+          "replicas": len(router.replicas),
+          "hit_rate": round(routed_rate, 4), "primary_routed": primary,
+          "fallback_routed": fallback, "wall_s": round(routed_wall, 3)})
+
+    proc, base = _spawn_replica()
+    try:
+        base_outs = [collect_stream(base,
+                                    {"prompt": p,
+                                     "max_new_tokens":
+                                         args.router_new_tokens})
+                     for p in reqs]
+        base_rate = _scraped_hit_rate([_scrape(base)])
+    finally:
+        _terminate(proc)
+    emit({"cell": "router_baseline", "requests": len(reqs),
+          "hit_rate": round(base_rate, 4)})
+
+    complete = all(o["status"] == 200 and o["done"]
+                   for o in routed_outs + base_outs)
+    identical = ([o["tokens"] for o in routed_outs]
+                 == [o["tokens"] for o in base_outs])
+    # the verdict the sticky hash exists for: sharding must NOT decay
+    # the fleet hit rate (random routing re-prefills each group once
+    # per replica and lands well below the single-replica rate)
+    ok = bool(complete and identical
+              and routed_rate >= base_rate - 0.05
+              and fallback == 0 and primary == len(reqs))
+    return ok, {"hit_rate_routed": round(routed_rate, 4),
+                "hit_rate_single": round(base_rate, 4),
+                "tokens_identical": bool(identical)}
+
+
+def _phase_drain(args, router, procs, systems, rng):
+    """SIGTERM one replica while streams it serves are mid-flight:
+    every stream must still end in [DONE] with the full token count
+    (the drain contract), the replica must exit 75, and a follow-up
+    request sticky to the dead replica must be served by the survivor
+    via the fallback path."""
+    from paddle_tpu.serve.router import prefix_shard
+    from paddle_tpu.serve.sse import collect_stream, stream_completion
+
+    n_tokens = 4 * args.router_new_tokens    # long enough to be mid-flight
+    prompts = [s + rng.integers(0, _REPLICA_VOCAB - 1, 4).tolist()
+               for s in systems]
+    victim_idx = prefix_shard(prompts[0], len(procs),
+                              args.router_system_len)
+    results, lock = [], threading.Lock()
+
+    def fire(p):
+        out = collect_stream(router.url,
+                             {"prompt": p, "max_new_tokens": n_tokens},
+                             timeout=60)
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=fire, args=(p,), daemon=True)
+               for p in prompts[1:]]
+    for t in threads:
+        t.start()
+    # the main thread holds a stream PINNED to the victim: two events
+    # in means the SIGTERM provably lands mid-generation
+    s = stream_completion(router.url,
+                          {"prompt": prompts[0],
+                           "max_new_tokens": n_tokens}, timeout=60)
+    tokens = []
+    it = s.events()
+    for _ in range(2):
+        ev = next(it)
+        if "token" in ev:
+            tokens.append(ev["token"])
+    procs[victim_idx][0].terminate()
+    final = None
+    for ev in it:
+        if "token" in ev:
+            tokens.append(ev["token"])
+        if ev.get("done"):
+            final = ev
+    for t in threads:
+        t.join(timeout=90)
+    victim_exit = procs[victim_idx][0].wait(timeout=60)
+
+    truncated = (0 if s.done else 1) + sum(1 for r in results
+                                           if not r["done"])
+    short = (0 if len(tokens) == n_tokens else 1) + sum(
+        1 for r in results if len(r["tokens"]) != n_tokens)
+    # sticky target is gone: the router must fail the request over
+    after = collect_stream(router.url,
+                           {"prompt": prompts[0][:args.router_system_len]
+                            + rng.integers(0, _REPLICA_VOCAB - 1,
+                                           4).tolist(),
+                            "max_new_tokens": args.router_new_tokens},
+                           timeout=60)
+    fam = router.obs.get("ptpu_router_requests_total")
+    fallback = sum(fam.labels(replica=r.url, kind="fallback").value
+                   for r in router.replicas)
+    emit({"cell": "router_drain", "streams": len(prompts),
+          "victim": procs[victim_idx][1], "victim_exit": victim_exit,
+          "truncated_streams": truncated, "short_streams": short,
+          "failover_status": after["status"],
+          "fallback_routed_total": fallback})
+    ok = bool(truncated == 0 and short == 0
+              and victim_exit == 75        # PREEMPT_EXIT_CODE
+              and final is not None and final.get("reason") == "length"
+              and after["status"] == 200 and after["done"]
+              and fallback > 0)
+    return ok, {"victim_exit": victim_exit, "truncated": truncated}
+
+
+def _phase_slo(args, rng):
+    """Admission control on a deliberately throughput-capped replica
+    (--max-batch-size 1 makes '2x the nominal sequential rate' a true
+    overload): zero sheds at nominal pace, nonzero slo_* sheds at 2x,
+    and the admitted p99 TTFT — scraped, not client-measured — stays
+    under the configured deadline because shedding bounds the queue."""
+    from paddle_tpu.serve.sse import collect_stream
+
+    proc, base = _spawn_replica(extra=(
+        "--max-batch-size", "1",
+        "--max-queue-depth", "1024",        # sheds must come from SLO
+        "--slo-queue-wait-ms", "100", "--slo-target", "0.5",
+        "--slo-short-window-s", "1", "--slo-long-window-s", "8",
+        "--slo-min-samples", "3", "--slo-interval-s", "0.05"))
+    try:
+        def prompt():
+            return rng.integers(0, _REPLICA_VOCAB - 1, 8).tolist()
+
+        n_nominal = 8
+        t0 = time.perf_counter()
+        nominal = [collect_stream(base, {"prompt": prompt(),
+                                         "max_new_tokens": 16})
+                   for _ in range(n_nominal)]
+        per_req = (time.perf_counter() - t0) / n_nominal
+        sheds_nominal, _ = _shed_counts(_scrape(base))
+        nominal_ok = all(o["status"] == 200 and o["done"]
+                         for o in nominal)
+        emit({"cell": "router_slo_nominal", "requests": n_nominal,
+              "per_req_s": round(per_req, 4),
+              "sheds": sheds_nominal})
+
+        results, lock = [], threading.Lock()
+
+        def fire():
+            out = collect_stream(base, {"prompt": prompt(),
+                                        "max_new_tokens": 16},
+                                 timeout=60)
+            with lock:
+                results.append(out)
+
+        threads = []
+        t_end = time.monotonic() + args.slo_overload_s
+        while time.monotonic() < t_end:
+            t = threading.Thread(target=fire, daemon=True)
+            t.start()
+            threads.append(t)
+            time.sleep(per_req / 2)         # 2x the sequential rate
+        for t in threads:
+            t.join(timeout=90)
+
+        vals = _scrape(base)
+        sheds_total, sheds_slo = _shed_counts(vals)
+        p99_ttft = _scraped_quantile(vals, "ptpu_serve_ttft_ms", 0.99)
+        admitted = [r for r in results if r["status"] == 200]
+        admitted_ok = all(r["done"] and len(r["tokens"]) == 16
+                          for r in admitted)
+        emit({"cell": "router_slo_overload", "requests": len(results),
+              "admitted": len(admitted),
+              "client_503s": len(results) - len(admitted),
+              "sheds_total": sheds_total, "sheds_slo": sheds_slo,
+              "p99_ttft_ms": round(p99_ttft, 3),
+              "deadline_ms": args.slo_deadline_ms})
+    finally:
+        _terminate(proc)
+    ok = bool(nominal_ok and admitted_ok
+              and sheds_nominal == 0 and sheds_slo > 0
+              and p99_ttft < args.slo_deadline_ms)
+    return ok, {"sheds_nominal": sheds_nominal, "sheds_slo": sheds_slo,
+                "p99_ttft_ms": round(p99_ttft, 3)}
+
+
+def scenario_router(model, variables, args):
+    """Two replica processes + a Router, verdicts read from scrapes.
+    The in-process model is unused — the fleet holds the replica CLI's
+    default model so identical weights come from the seed, the way a
+    real deployment would start N copies of one checkpoint."""
+    del model, variables
+    from paddle_tpu.serve.router import Router
+
+    rng = np.random.default_rng(7)
+    systems = [rng.integers(0, _REPLICA_VOCAB - 1,
+                            args.router_system_len).tolist()
+               for _ in range(args.router_groups)]
+    # round-robin across groups: consecutive requests hash to
+    # DIFFERENT replicas, so stickiness (not recency) carries the rate
+    reqs = [systems[g] + rng.integers(0, _REPLICA_VOCAB - 1, 4).tolist()
+            for _ in range(args.router_tails)
+            for g in range(args.router_groups)]
+
+    procs = [_spawn_replica() for _ in range(2)]
+    router = Router([base for _, base in procs],
+                    prefix_len=args.router_system_len,
+                    scrape_interval_s=0.2).start()
+    try:
+        ok_sticky, sticky = _phase_sticky(args, router, reqs)
+        ok_drain, drain = _phase_drain(args, router, procs, systems, rng)
+    finally:
+        router.stop()
+        for proc, _ in procs:
+            _terminate(proc)
+    ok_slo, slo = _phase_slo(args, rng)
+
+    ok = bool(ok_sticky and ok_drain and ok_slo)
+    emit({"cell": "router_verdict", "ok": ok,
+          "sticky_ok": ok_sticky, "drain_ok": ok_drain,
+          "slo_ok": ok_slo, **sticky, **drain, **slo})
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
-                             "mixed"])
+                             "mixed", "router"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -390,14 +760,31 @@ def main():
     ap.add_argument("--max-len", type=int, default=128)
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=256)
+    # router scenario (replica fleet + scraped verdicts)
+    ap.add_argument("--router-system-len", type=int, default=16,
+                    help="shared system-prompt length per prefix group "
+                    "(doubles as the router's sticky prefix_len)")
+    ap.add_argument("--router-groups", type=int, default=4)
+    ap.add_argument("--router-tails", type=int, default=4,
+                    help="requests per prefix group")
+    ap.add_argument("--router-new-tokens", type=int, default=8)
+    ap.add_argument("--slo-overload-s", type=float, default=3.0,
+                    help="duration of the 2x-rate overload burst")
+    ap.add_argument("--slo-deadline-ms", type=float, default=5000.0,
+                    help="admitted p99 TTFT must stay under this "
+                    "during the overload burst")
     ap.add_argument("--metrics-out", default=None, metavar="FILE",
                     help="write the last verdict engine's Prometheus "
                     "exposition here at end of run")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="write the last in-process verdict engine's "
+                    "request-lifecycle Chrome trace here at end of run")
     args = ap.parse_args()
 
     model, variables = build_model(args)
     scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
-                 "chunked": scenario_chunked, "mixed": scenario_mixed}
+                 "chunked": scenario_chunked, "mixed": scenario_mixed,
+                 "router": scenario_router}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
@@ -408,6 +795,16 @@ def main():
             f.write(LAST_EXPOSITION)
         emit({"cell": "metrics_out", "path": args.metrics_out,
               "bytes": len(LAST_EXPOSITION)})
+    if args.trace_out:
+        if LAST_TRACER is None:
+            emit({"cell": "trace_out", "path": args.trace_out,
+                  "skipped": "no in-process scenario ran"})
+        else:
+            from paddle_tpu.obs.tracing import merged_chrome_trace
+
+            trace = merged_chrome_trace(LAST_TRACER, path=args.trace_out)
+            emit({"cell": "trace_out", "path": args.trace_out,
+                  "events": len(trace["traceEvents"])})
     emit({"cell": "TOTAL", "ok": all(oks.values()), **oks})
     return 0 if all(oks.values()) else 1
 
