@@ -7,6 +7,7 @@ lives in a block-pool `PagedKVCache`, and decode attention gathers
 through block tables (kernels/paged_attention.py).
 """
 
+from paddle_tpu.engine.draft import NgramDrafter
 from paddle_tpu.engine.engine import ServeEngine, serve_metadata
 from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
 from paddle_tpu.engine.scheduler import (PrefillChunk, Request, Scheduler,
@@ -14,4 +15,4 @@ from paddle_tpu.engine.scheduler import (PrefillChunk, Request, Scheduler,
 
 __all__ = ["ServeEngine", "serve_metadata", "PagedKVCache",
            "CacheExhausted", "Scheduler", "Request", "StepRow",
-           "PrefillChunk"]
+           "PrefillChunk", "NgramDrafter"]

@@ -36,10 +36,28 @@ tokens at the same positions by the same compiled chunk step, and
 masked attention lanes underflow to exact zero, so reusing it is
 bit-identical to recomputing it (tests/test_prefix_cache.py).
 
-Sampling runs on host from the [B, V] logits (greedy / temperature /
-top-k). Stochastic sampling derives its rng stream from
+Sampling runs on host from the [B, spec_len, V] logits (greedy /
+temperature / top-k). Stochastic sampling derives its rng stream from
 (request seed, absolute position), never from batch composition, so
 scheduling decisions can't change a request's output.
+
+Two features ride that determinism with zero new compiled paths:
+
+- SPECULATIVE DECODING (spec_k > 0, engine/draft.py): a model-free
+  prompt-lookup drafter proposes up to k tokens per decode-ready
+  sequence; the scheduler widens that row's window to 1 + k tokens (the
+  same multi-token shape a prefill chunk uses) so the ONE compiled step
+  scores all positions in a single launch. Verification accepts the
+  longest draft prefix where draft[j] equals what _sample would have
+  produced anyway — exact under greedy AND temperature, because a
+  deterministic point-mass proposal degenerates rejection sampling to a
+  token-identity test. Rejected positions roll back by simply not
+  advancing the cache: the stale KV past _lens is re-reserved and
+  overwritten by later appends.
+- PARALLEL SAMPLING (add_request(n=...)): a finished prefill forks into
+  n candidates sharing every prompt block (refcount bump + COW), each
+  decoding under seed + i; candidate streams are bit-identical to solo
+  runs with those seeds.
 """
 
 from __future__ import annotations
@@ -53,7 +71,8 @@ import numpy as np
 
 from paddle_tpu.core.module import Context, _CtxCore
 from paddle_tpu.engine.paged_cache import PagedKVCache
-from paddle_tpu.engine.scheduler import Request, Scheduler, StepRow
+from paddle_tpu.engine.scheduler import (RUNNING, Request, Scheduler,
+                                         StepRow)
 from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
 from paddle_tpu.obs.tracing import RequestTracer
 from paddle_tpu.utils.log import serve_event
@@ -87,12 +106,22 @@ def serve_metadata(model) -> dict:
     }
 
 
-def _sample(logits: np.ndarray, req: Request, pos: int) -> int:
-    """Host-side sampling for one row. Deterministic in (req.seed, pos):
-    the same request samples the same token at the same position no
-    matter what batch it rode in."""
+def _sample(logits: np.ndarray, req: Request, pos: int
+            ) -> "tuple[int, float]":
+    """Host-side sampling for one row: (token, log-probability of that
+    token under the sampling distribution — greedy scores against the
+    plain softmax). Deterministic in (req.seed, pos): the same request
+    samples the same token at the same position no matter what batch
+    it rode in — which is ALSO what makes speculative verification
+    exact (a draft is accepted iff it equals this function's output at
+    its position) and best-of-n forks reproducible (candidate i ==
+    a solo run with seed + i). The logprob accumulates into
+    Request.logprob_sum, the best_of ranking signal."""
     if req.temperature <= 0.0:
-        return int(np.argmax(logits))
+        tok = int(np.argmax(logits))
+        z = logits.astype(np.float64)
+        z = z - z.max()
+        return tok, float(z[tok] - np.log(np.exp(z).sum()))
     z = logits.astype(np.float64) / req.temperature
     if 0 < req.top_k < z.size:
         kth = np.partition(z, -req.top_k)[-req.top_k]
@@ -101,7 +130,8 @@ def _sample(logits: np.ndarray, req: Request, pos: int) -> int:
     p = np.exp(z)
     p /= p.sum()
     rng = np.random.default_rng([req.seed & 0x7FFFFFFF, pos])
-    return int(rng.choice(z.size, p=p))
+    tok = int(rng.choice(z.size, p=p))
+    return tok, float(np.log(p[tok]))
 
 
 class ServeEngine:
@@ -129,6 +159,8 @@ class ServeEngine:
                  max_prefill_tokens: int = 512,
                  tile_q: int = 8,
                  enable_prefix_cache: bool = True,
+                 spec_k: int = 0,
+                 drafter=None,
                  registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[RequestTracer] = None):
         self.model = model
@@ -156,11 +188,32 @@ class ServeEngine:
                         clamped_to=self.max_seq_len)
             max_prefill_tokens = self.max_seq_len
         self.tile_q = tile_q
+        # speculative decoding (engine/draft.py): spec_k > 0 turns
+        # decode rows into multi-token verification windows of up to
+        # 1 + spec_k tokens. The ONE compiled step absorbs that by
+        # sizing each row's worst-case decode segment to the rounded
+        # window (spec_k = 0 reproduces the old B * tile_q exactly) and
+        # gathering spec_len logit positions per row instead of 1 —
+        # draft length changes are int32-operand changes, never shape
+        # changes.
+        if spec_k < 0:
+            raise ValueError(f"spec_k {spec_k} < 0")
+        if drafter is None and spec_k > 0:
+            from paddle_tpu.engine.draft import NgramDrafter
+            drafter = NgramDrafter(k=spec_k)
+        if drafter is not None:
+            # the compiled shape must fit the drafter's longest window
+            spec_k = max(spec_k, drafter.k)
+        self.spec_k = spec_k
+        self.spec_len = spec_k + 1          # logit positions per row
+        self.drafter = drafter
         # flat step sizing: every row's segment is tile-aligned, so the
         # worst case is max_batch_size rows each wasting tile_q - 1
-        # slots on top of the chunk budget
-        self.flat_tokens = (-(-max_prefill_tokens // tile_q) * tile_q
-                            + max_batch_size * tile_q)
+        # slots on top of the chunk budget (decode windows grow to
+        # 1 + spec_k tokens under speculation)
+        self.flat_tokens = (
+            -(-max_prefill_tokens // tile_q) * tile_q
+            + max_batch_size * (-(-self.spec_len // tile_q) * tile_q))
         self.num_tiles = self.flat_tokens // tile_q
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
@@ -171,7 +224,8 @@ class ServeEngine:
         self.scheduler = Scheduler(
             self.cache, max_batch_size=max_batch_size,
             max_prefill_tokens=max_prefill_tokens,
-            max_seq_len=self.max_seq_len - 1)  # leave room for >=1 new token
+            max_seq_len=self.max_seq_len - 1,  # leave room for >=1 new token
+            drafter=self.drafter)
         self.scheduler.on_preempt = self._on_preempt
         self.scheduler.on_admit = self._on_admit
         self.finished: Dict[int, Request] = {}
@@ -250,7 +304,7 @@ class ServeEngine:
             "ptpu_serve_e2e_ms", "Enqueue to finish (ms)")
         self._m_step = m.histogram(
             "ptpu_serve_step_ms", "Engine step wall time (ms)",
-            labelnames=("kind",))        # kind=decode|prefill|mixed
+            labelnames=("kind",))        # kind=decode|prefill|mixed|spec
         self._m_reqs = m.counter(
             "ptpu_serve_requests_total", "Finished requests",
             labelnames=("reason",))      # reason=eos|length|cancelled
@@ -285,6 +339,20 @@ class ServeEngine:
             "prefill-bearing step")
         self._m_preempts = m.counter(
             "ptpu_sched_preemptions_total", "Recompute preemptions")
+        # speculative decoding (acceptance telemetry; the step-latency
+        # comparison rides ptpu_serve_step_ms{kind="spec"} vs "decode")
+        self._m_spec_drafted = m.counter(
+            "ptpu_spec_drafted_tokens_total",
+            "Draft tokens proposed for batched verification")
+        self._m_spec_accepted = m.counter(
+            "ptpu_spec_accepted_tokens_total",
+            "Draft tokens accepted (emitted beyond the base token)")
+        self._m_spec_rejected = m.counter(
+            "ptpu_spec_rejected_tokens_total",
+            "Draft tokens rejected (their written KV rolled back)")
+        self._m_spec_ratio = m.histogram(
+            "ptpu_spec_acceptance_ratio",
+            "Per-speculative-row accepted/drafted ratio")
 
     def _on_admit(self, req: Request) -> None:
         """Scheduler hook: a request left the wait queue. Queue-wait is
@@ -316,9 +384,23 @@ class ServeEngine:
                     temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                     eos_id: Optional[int] = None,
                     callback: Optional[Callable[[int], None]] = None,
-                    deadline_ms: Optional[float] = None) -> Request:
+                    deadline_ms: Optional[float] = None,
+                    n: int = 1,
+                    fork_callback: Optional[Callable] = None) -> Request:
+        """Enqueue one completion. `n > 1` is parallel sampling: when
+        this request's prefill finishes, the engine forks n - 1 sibling
+        candidates off its prompt blocks (refcount bump, zero copies —
+        PagedKVCache.fork_sequence), each sampling with seed + i, and
+        all n decode concurrently. The returned primary is candidate 0;
+        its `forks` list holds the siblings. fork_callback(i) -> token
+        callback (or None for a silent candidate) wires sibling
+        streams."""
         if not prompt:
             raise ValueError("empty prompt")
+        if not 1 <= n <= self.max_batch_size:
+            raise ValueError(
+                f"n {n} not in [1, max_batch_size={self.max_batch_size}]: "
+                "every candidate needs a batch slot to decode")
         if len(prompt) + 1 > self.max_seq_len:
             raise ValueError(f"prompt len {len(prompt)} leaves no room to "
                              f"generate under max_seq_len {self.max_seq_len}")
@@ -329,7 +411,8 @@ class ServeEngine:
                 f"{self.cache.block_size}); raise num_blocks")
         req = Request(prompt=list(prompt), max_new_tokens=max_new_tokens,
                       temperature=temperature, top_k=top_k, seed=seed,
-                      eos_id=eos_id, callback=callback)
+                      eos_id=eos_id, callback=callback,
+                      n_candidates=n, fork_callback=fork_callback)
         req.enqueue_time = time.monotonic()
         if deadline_ms is not None:
             # absolute completion deadline: the scheduler preempts the
@@ -365,6 +448,17 @@ class ServeEngine:
                     occupancy=round(self.cache.occupancy(), 4))
         return True
 
+    def cancel_group(self, req: Request, reason: str = "cancelled") -> int:
+        """Cancel a parallel-sampling group: the primary and every fork
+        it spawned (a client disconnect must drop ALL n candidates'
+        block references, returning shared-prompt refcounts to
+        baseline). Safe for n == 1 (forks is empty) and before the fork
+        happened (cancelling the still-prefilling primary means the
+        siblings are simply never created). Returns how many candidates
+        were actually cancelled."""
+        return sum(1 for r in [req] + req.forks
+                   if self.cancel(r, reason))
+
     # -- serve loop --------------------------------------------------------
     def step(self) -> bool:
         """Advance one scheduler plan (one mixed batch through the
@@ -374,11 +468,15 @@ class ServeEngine:
         if rows is None:
             return False
         self.steps += 1
-        n_chunks, n_decodes, chunk_tokens = self._step_mixed(rows)
+        n_chunks, n_decodes, chunk_tokens, n_drafted = \
+            self._step_mixed(rows)
         self.peak_occupancy = max(self.peak_occupancy,
                                   self.cache.occupancy())
         # per-step telemetry: host-side gauge/histogram writes only
-        kind = ("mixed" if n_chunks and n_decodes
+        # ("spec" wins over mixed/decode so the speculation-on latency
+        # distribution is separable from plain decode's)
+        kind = ("spec" if n_drafted
+                else "mixed" if n_chunks and n_decodes
                 else "prefill" if n_chunks else "decode")
         self._m_step.labels(kind=kind).observe(
             (time.perf_counter() - t0) * 1e3)
@@ -419,16 +517,25 @@ class ServeEngine:
                 self.cache.pools, jnp.asarray(src), jnp.asarray(dst))
 
     def _step_mixed(self, rows: List[StepRow]
-                    ) -> "tuple[int, int, int]":
+                    ) -> "tuple[int, int, int, int]":
         """Pack the plan's rows — decode rows AND prefill chunks — into
         the flat ragged layout and run ONE compiled step. Row i's token
         window [start, start+length) lands in a tile_q-aligned segment
         of the [T] arrays; per-row metadata (block table, chunk-end
         context, start position) sits at index i, and the null row at
         index max_batch_size backs pad tiles (ctx 1, scratch table).
-        For a decode row the window is [seq_len, seq_len+1) of
-        req.tokens — i.e. exactly the last generated token at its
-        next-token position, which is what the old decode step fed."""
+        For a plain decode row the window is [seq_len, seq_len+1) of
+        req.tokens — exactly the last generated token at its next-token
+        position, which is what the old decode step fed. A SPECULATIVE
+        row widens that window to [seq_len, seq_len+1+k): the base
+        token followed by k drafted tokens (scheduler StepRow.draft) —
+        the same multi-token shape a prefill chunk uses, so the ragged
+        kernel scores all k+1 positions in the one launch (each window
+        position scatters its own k/v before attention reads it,
+        exactly as chunk rows already do). last_idx is [B, spec_len]:
+        speculative rows gather one hidden state per window position
+        for verification; every other row repeats its single real
+        index across the columns."""
         self._flush_cow()
         t_flat, tq, nt = self.flat_tokens, self.tile_q, self.num_tiles
         b = self.max_batch_size
@@ -442,13 +549,17 @@ class ServeEngine:
         q_starts = np.zeros((b + 1,), np.int32)
         tile_rows = np.full((nt,), b, np.int32)      # pad tiles -> null row
         tile_offs = np.zeros((nt,), np.int32)
-        last_idx = np.zeros((b,), np.int32)
+        last_idx = np.zeros((b, self.spec_len), np.int32)
         cursor = 0
         for i, row in enumerate(rows):
             r = row.req
             toks = r.tokens
-            tokens[cursor:cursor + row.length] = \
-                toks[row.start:row.start + row.length]
+            if row.draft:
+                # draft tokens live only in the plan, not in req.tokens
+                window = [toks[row.start]] + row.draft
+            else:
+                window = toks[row.start:row.start + row.length]
+            tokens[cursor:cursor + row.length] = window
             positions[cursor:cursor + row.length] = np.arange(
                 row.start, row.start + row.length, dtype=np.int32)
             for p in range(row.length):
@@ -457,7 +568,14 @@ class ServeEngine:
             block_tables[i] = self.cache.padded_table(r.req_id, mb)
             context_lens[i] = row.start + row.length
             q_starts[i] = row.start
-            last_idx[i] = cursor + row.length - 1
+            if row.decode:
+                # verification gathers per-position logits (plain
+                # decode rows have length 1: every column clamps to
+                # the one real index)
+                for j in range(self.spec_len):
+                    last_idx[i, j] = cursor + min(j, row.length - 1)
+            else:
+                last_idx[i, :] = cursor + row.length - 1
             ntiles = -(-row.length // tq)
             t0 = cursor // tq
             for k in range(ntiles):
@@ -475,19 +593,55 @@ class ServeEngine:
         decodes = [w for w in rows if w.decode]
         computed = sum(w.length for w in chunks)
         now = time.monotonic()
+        drafted = accepted = 0
         for i, row in enumerate(rows):
             r = row.req
             if row.decode:
                 # the step wrote r.generated[-1]'s k/v at the reserved
                 # slot
                 self.cache.advance(r.req_id, r.generated[-1])
-                tok = _sample(logits[i], r, self.cache.seq_len(r.req_id))
-                self._emit_token(r, tok)
+                row_accepted = 0
+                for j in range(len(row.draft) + 1):
+                    # logits[i, j] scored window position start+j, i.e.
+                    # it predicts the token at cache seq_len (which the
+                    # advances below keep in lockstep with j)
+                    tok, lp = _sample(logits[i, j], r,
+                                      self.cache.seq_len(r.req_id))
+                    r.logprob_sum += lp
+                    self._emit_token(r, tok)
+                    if r.finish_reason or j >= len(row.draft):
+                        break
+                    if row.draft[j] != tok:
+                        # first rejection: everything past seq_len is
+                        # dead weight — rollback is simply NOT
+                        # advancing; the stale k/v beyond _lens gets
+                        # re-reserved and overwritten by later appends
+                        break
+                    # draft j verified: its k/v (scattered this launch)
+                    # IS the true token's k/v, so advancing onto it
+                    # lets the next column's logits be consumed too
+                    self.cache.advance(r.req_id, tok)
+                    row_accepted += 1
+                if row.draft:
+                    drafted += len(row.draft)
+                    accepted += row_accepted
+                    self._m_spec_drafted.inc(len(row.draft))
+                    self._m_spec_accepted.inc(row_accepted)
+                    self._m_spec_rejected.inc(
+                        len(row.draft) - row_accepted)
+                    self._m_spec_ratio.observe(
+                        row_accepted / len(row.draft))
             else:
                 self.cache.commit_prefill(r.req_id, row.start + row.length)
                 self.tracer.on_chunk(r.req_id, row.start, row.length)
                 if row.start + row.length == len(r.prompt):  # final chunk
-                    tok = _sample(logits[i], r, len(r.prompt))
+                    if r.n_candidates > 1 and not r.forks:
+                        # fork BEFORE the primary consumes the logits:
+                        # each sibling samples its first token from the
+                        # same final-chunk row under its own seed
+                        self._fork_candidates(r, logits[i, 0], now)
+                    tok, lp = _sample(logits[i, 0], r, len(r.prompt))
+                    r.logprob_sum += lp
                     if not r.first_token_time:
                         r.first_token_time = now
                     self.tracer.on_first_token(r.req_id)
@@ -514,10 +668,59 @@ class ServeEngine:
                         queue_depth=self.scheduler.queue_depth)
         if decodes:
             serve_event("serve_decode", batch=len(decodes),
-                        step=self.steps,
+                        step=self.steps, drafted=drafted,
+                        accepted=accepted,
                         occupancy=round(self.cache.occupancy(), 4),
                         queue_depth=self.scheduler.queue_depth)
-        return len(chunks), len(decodes), computed
+        return len(chunks), len(decodes), computed, drafted
+
+    def _fork_candidates(self, primary: Request, logits_row: np.ndarray,
+                         now: float) -> None:
+        """Split a finished prefill into n parallel-sampling candidates.
+        Each sibling's cache sequence shares EVERY prompt block with the
+        primary — fork_sequence only bumps refcounts; COW peels a
+        private copy the first time a candidate writes into a shared
+        block — so the prompt is prefilled once and held once no matter
+        how large n is. Siblings enter the running set decode-ready
+        (prefill_pos == len(prompt)) and sample their FIRST token from
+        the same final-chunk logits row under seed + i: because
+        _sample is deterministic in (seed, position) and the ragged
+        step's rows are batch-invariant, candidate i's whole stream is
+        bit-identical to a solo run submitted with that seed."""
+        for i in range(1, primary.n_candidates):
+            cb = (primary.fork_callback(i)
+                  if primary.fork_callback is not None else None)
+            sib = Request(
+                prompt=list(primary.prompt),
+                max_new_tokens=primary.max_new_tokens,
+                temperature=primary.temperature,
+                top_k=primary.top_k,
+                seed=primary.seed + i,
+                eos_id=primary.eos_id,
+                callback=cb,
+                deadline=primary.deadline,
+                cand_index=i,
+                parent=primary)
+            sib.enqueue_time = primary.enqueue_time
+            sib.admit_time = primary.admit_time
+            sib.prefill_pos = len(sib.prompt)      # decode-ready
+            sib.cached_tokens = len(sib.prompt)    # whole prompt shared
+            sib.state = RUNNING
+            self.cache.fork_sequence(primary.req_id, sib.req_id)
+            self.scheduler.running.append(sib)
+            primary.forks.append(sib)
+            self.tracer.on_enqueue(sib.req_id)
+            self.tracer.on_admit(sib.req_id)
+            tok, lp = _sample(logits_row, sib, len(sib.prompt))
+            sib.logprob_sum += lp
+            sib.first_token_time = now
+            self.tracer.on_first_token(sib.req_id)
+            self._emit_token(sib, tok)
+        self._set_sched_gauges()
+        serve_event("serve_fork", req_id=primary.req_id,
+                    candidates=primary.n_candidates,
+                    shared_blocks=self.cache.shared_blocks,
+                    occupancy=round(self.cache.occupancy(), 4))
 
     def _emit_token(self, req: Request, tok: int) -> None:
         req.generated.append(tok)

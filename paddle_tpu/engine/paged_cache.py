@@ -304,18 +304,66 @@ class PagedKVCache:
         returns the FLAT pool slot (block_id * block_size + offset) the
         engine passes to the decode step. Does NOT advance the length —
         call advance() after the step actually writes."""
+        return self.reserve_slots(seq_id, 1)[0]
+
+    def reserve_slots(self, seq_id: int, count: int) -> List[int]:
+        """Reserve the next `count` token slots in one ALL-OR-NOTHING
+        transaction (the speculative-decode path: the base token plus k
+        draft tokens land in one multi-token StepRow, so either the
+        whole window gets slots or the scheduler falls back to a plain
+        1-token decode). The bill is pre-checked — COW copies for
+        shared blocks the window touches plus fresh blocks past the
+        table's end — and CacheExhausted raises BEFORE any refcount or
+        table mutation, so a failed reservation leaves nothing to roll
+        back. Returns the flat pool slots in window order. Like
+        append_token, the length does not advance: the engine calls
+        advance() only for positions verification actually accepted,
+        and un-advanced slots are simply re-reserved (and overwritten)
+        by the next step — that IS the speculative rollback."""
         pos = self._lens[seq_id]
         table = self._tables[seq_id]
-        if pos == len(table) * self.block_size:     # block boundary
-            if not self._free:
-                raise CacheExhausted("no free block for decode append")
+        bs = self.block_size
+        end = pos + count
+        in_table_end = min(end, len(table) * bs)
+        cow_need = 0
+        if in_table_end > pos:
+            cow_need = sum(
+                1 for bi in range(pos // bs, (in_table_end - 1) // bs + 1)
+                if self._refs[table[bi]] > 1)
+        new_need = max(0, self.blocks_for(end) - len(table))
+        if cow_need + new_need > len(self._free):
+            raise CacheExhausted(
+                f"need {cow_need + new_need} blocks ({cow_need} COW + "
+                f"{new_need} fresh), {len(self._free)} free")
+        if in_table_end > pos:
+            self.ensure_writable(seq_id, pos, in_table_end)
+        for _ in range(new_need):
             block = self._pop_free()
             self._refs[block] = 1
             table.append(block)
-        else:
-            self.ensure_writable(seq_id, pos, pos + 1)
-        return table[pos // self.block_size] * self.block_size \
-            + pos % self.block_size
+        return [table[(pos + j) // bs] * bs + (pos + j) % bs
+                for j in range(count)]
+
+    def fork_sequence(self, src_id: int, dst_id: int) -> None:
+        """Clone `src_id`'s sequence state into `dst_id` sharing EVERY
+        block (refcount bump — zero new blocks, zero device copies):
+        the parallel-sampling / best-of-n primitive. A finished prefill
+        forks into n candidates that all read the same prompt KV; the
+        first time a fork WRITES (its own generated tokens, starting
+        with the shared partially-filled tail block) the ordinary
+        ensure_writable copy-on-write path peels it a private copy.
+        free_sequence needs no special casing: a fork's exclusive
+        blocks (refcount 1) return to the free list, shared prompt
+        blocks just drop one reference."""
+        if dst_id in self._tables:
+            raise ValueError(f"sequence {dst_id} already allocated")
+        table = self._tables[src_id]
+        for b in table:
+            self._refs[b] += 1
+        self._tables[dst_id] = list(table)
+        self._lens[dst_id] = self._lens[src_id]
+        self._tokens[dst_id] = list(self._tokens[src_id])
+        self._committed[dst_id] = self._committed[src_id]
 
     def advance(self, seq_id: int, token: int) -> None:
         """The decode step wrote `token`'s k/v at the reserved slot:

@@ -71,6 +71,21 @@ class Request:
     # request with the MOST slack, so tight-deadline requests keep
     # their KV state under pool pressure.
     deadline: float = float("inf")
+    # parallel sampling (best-of-n): the engine forks n_candidates - 1
+    # siblings off this request's finished prefill, all sharing its
+    # prompt blocks (PagedKVCache.fork_sequence). Siblings are ordinary
+    # requests with cand_index > 0 and `parent` set; the primary lists
+    # them in `forks`. fork_callback(i) builds sibling i's per-token
+    # stream callback (None = decode silently, the best_of > n case).
+    n_candidates: int = 1
+    cand_index: int = 0
+    parent: Optional["Request"] = None
+    forks: List["Request"] = field(default_factory=list)
+    fork_callback: Optional[Callable[[int],
+                                     Optional[Callable[[int], None]]]] = None
+    # cumulative log-probability of the sampled tokens under each
+    # step's sampling distribution — the best-of-n ranking signal
+    logprob_sum: float = 0.0
     req_id: int = field(default_factory=lambda: next(_req_ids))
     generated: List[int] = field(default_factory=list)
     state: str = WAITING
@@ -104,13 +119,17 @@ class Request:
 @dataclass
 class StepRow:
     """One row of a mixed step: run `req`'s token window
-    [start, start + length). decode=True is the 1-token next-token
-    window of a decode-ready sequence (its block already reserved);
-    decode=False is a prefill chunk of the prompt."""
+    [start, start + length). decode=True is the next-token window of a
+    decode-ready sequence (its slots already reserved); decode=False
+    is a prefill chunk of the prompt. A decode row with a non-empty
+    `draft` is a SPECULATIVE row: its window is [start, start+1+k) —
+    the base token plus k drafted tokens — and the engine verifies all
+    k positions from the one launch, emitting the accepted prefix."""
     req: Request
     start: int
     length: int
     decode: bool = False
+    draft: List[int] = field(default_factory=list)
 
 
 # back-compat alias: a prefill chunk is a StepRow with decode=False
@@ -128,11 +147,16 @@ class Scheduler:
     prompt+generation."""
 
     def __init__(self, cache: PagedKVCache, max_batch_size: int = 8,
-                 max_prefill_tokens: int = 512, max_seq_len: int = 2048):
+                 max_prefill_tokens: int = 512, max_seq_len: int = 2048,
+                 drafter=None):
         self.cache = cache
         self.max_batch_size = max_batch_size
         self.max_prefill_tokens = max_prefill_tokens
         self.max_seq_len = max_seq_len
+        # speculative decoding (engine/draft.py): when set, decode-ready
+        # rows carry up to drafter.k drafted tokens for batched
+        # verification; None = plain 1-token decode rows
+        self.drafter = drafter
         self.waiting: deque[Request] = deque()
         self.running: List[Request] = []
         # engine hooks: fired after a preemption moves a req back to
@@ -189,6 +213,22 @@ class Scheduler:
                 budget -= take
                 rows.append(StepRow(req, start, take, decode=False))
             else:
+                draft = self._propose_draft(req)
+                if draft:
+                    try:
+                        # all-or-nothing: base token + k draft slots in
+                        # one transaction; a short pool drops the draft
+                        # (below) rather than preempting for it —
+                        # speculation is an optimization, never worth
+                        # evicting a neighbor's KV state
+                        self.cache.reserve_slots(req.req_id,
+                                                 1 + len(draft))
+                        rows.append(StepRow(
+                            req, self.cache.seq_len(req.req_id),
+                            1 + len(draft), decode=True, draft=draft))
+                        continue
+                    except CacheExhausted:
+                        pass
                 if self._reserve_decode_block(req):
                     rows.append(StepRow(
                         req, self.cache.seq_len(req.req_id), 1,
@@ -205,11 +245,36 @@ class Scheduler:
         self._check_liveness()
         return None
 
+    def _propose_draft(self, req: Request) -> List[int]:
+        """Draft tokens for one decode-ready row, capped so the whole
+        speculative window — base token + k drafts, each potentially
+        EMITTING a token — can never overrun the request's token budget
+        or the sequence-length ceiling."""
+        if self.drafter is None:
+            return []
+        room = min(self.drafter.k,
+                   req.max_new_tokens - req.num_generated - 1,
+                   self.max_seq_len - len(req.tokens) - 1)
+        if room <= 0:
+            return []
+        return self.drafter.propose(req.tokens, room)
+
+    def _slots_of(self, req: Request) -> int:
+        """Batch slots a request claims: itself, plus — while it still
+        prefills — one per sibling the engine will fork at its final
+        chunk. Admission counts the whole group up front so the forks'
+        decode rows are guaranteed batch room the moment they exist."""
+        if not req.prefilling:
+            return 1
+        return 1 + max(0, req.n_candidates - 1 - len(req.forks))
+
     def _try_admit(self) -> List[Request]:
         admitted: List[Request] = []
         while self.waiting:
             req = self.waiting[0]
-            if (len(self.running) + len(admitted) >= self.max_batch_size
+            slots = (sum(self._slots_of(r) for r in self.running)
+                     + sum(self._slots_of(r) for r in admitted))
+            if (slots + self._slots_of(req) > self.max_batch_size
                     or not self.cache.can_allocate(req.tokens)):
                 break       # FIFO: don't skip ahead of the head request
             self.waiting.popleft()

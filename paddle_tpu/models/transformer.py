@@ -712,10 +712,14 @@ class CausalLM(Module):
         position 0 and scatter to scratch slot 0). Per-ROW metadata
         block_tables [R, MB] / context_lens [R] / q_starts [R] and
         per-TILE tile_rows/tile_offs [NT] follow the
-        ragged_paged_attention contract. last_idx [B] int32 gathers
-        each planned row's final real token's hidden state; returns
-        (logits [B, V], new pools) — the engine samples only the rows
-        whose window ended a prompt or decoded a token."""
+        ragged_paged_attention contract. last_idx int32 gathers hidden
+        states by flat index: [B] yields logits [B, V] (one gather per
+        row — the pre-speculation contract), [B, S] yields [B, S, V]
+        (S gathers per row, used by speculative verification to score
+        every draft position from the same launch; non-speculative rows
+        just repeat their single real index across the S columns). The
+        engine samples only the rows whose window ended a prompt or
+        decoded a token."""
         x = self.embed(cx, tokens) * math.sqrt(self.model_dim)   # [T, D]
         pe = sinusoid_position_encoding(self.max_len, self.model_dim)
         pos_safe = jnp.clip(positions.astype(jnp.int32), 0, self.max_len - 1)
@@ -728,8 +732,10 @@ class CausalLM(Module):
                                            slots)
             new_pools.append(np_)
         hidden = self.ln_f(cx, x)                                # [T, D]
-        last_h = jnp.take(hidden, last_idx.astype(jnp.int32), axis=0)
-        return self._head(cx, last_h), new_pools
+        idx = last_idx.astype(jnp.int32)
+        last_h = jnp.take(hidden, idx.reshape(-1), axis=0)
+        logits = self._head(cx, last_h)
+        return logits.reshape(idx.shape + (logits.shape[-1],)), new_pools
 
     def decode_step_paged(self, cx: Context, tokens, positions, pools,
                           block_tables, context_lens, slots):

@@ -3,14 +3,20 @@
 One process, one port, two planes:
 
 - DATA PLANE — `POST /v1/completions`: JSON body in, server-sent
-  events out (one frame per sampled token, a final done frame with the
-  finish reason + full token list, then `[DONE]`). Streaming falls out
-  of the engine's iteration-level scheduling: the engine thread runs
-  `step()` continuously and per-token callbacks fan tokens out to
-  per-request queues that HTTP handler threads drain. A client that
-  disconnects mid-stream cancels its request — the engine frees the
-  sequence's KV blocks (shared prefix blocks drop one refcount) and
-  the loss shows up as `requests{reason="cancelled"}`.
+  events out (one frame per sampled token tagged with its candidate
+  `index` + in-candidate `pos`, a final done frame with the finish
+  reason + best token list, then `[DONE]`). `n` requests parallel
+  sampling — the engine forks n candidates off ONE shared prefill
+  (COW prompt blocks) and their frames interleave on the same
+  response; `best_of >= n` decodes extra silent candidates that only
+  compete in the mean-logprob ranking the done frame reports.
+  Streaming falls out of the engine's iteration-level scheduling: the
+  engine thread runs `step()` continuously and per-token callbacks fan
+  tokens out to per-request queues that HTTP handler threads drain. A
+  client that disconnects mid-stream cancels its whole group — the
+  engine frees every candidate's KV blocks (shared prefix blocks drop
+  one refcount each) and the loss shows up as
+  `requests{reason="cancelled"}`.
 - CONTROL PLANE — the same telemetry the engine records is what
   admits, sheds, and drains: `/metrics` (Prometheus scrape),
   `/healthz` (pure liveness), `/readyz` (503 until the one compiled
@@ -62,17 +68,21 @@ from paddle_tpu.utils.log import serve_event
 
 
 class _Stream:
-    """Plumbing for one in-flight completion: the engine thread feeds
-    `q`; the HTTP handler thread drains it. Items: ("token", int),
-    ("done", reason, tokens), ("error", message)."""
+    """Plumbing for one in-flight completion GROUP (1 primary +
+    n - 1 forked candidates share one HTTP response): the engine
+    thread feeds `q`; the HTTP handler thread drains it. Items:
+    ("token", int, cand_index), ("done", reason, tokens, extra) where
+    extra is None for n == 1 and {"best_index", "candidates"} for a
+    parallel-sampling group, ("error", message)."""
 
-    __slots__ = ("params", "q", "req", "streamed")
+    __slots__ = ("params", "q", "req", "streamed", "cand_pos")
 
     def __init__(self, params: dict):
         self.params = params
         self.q: "queue.Queue" = queue.Queue()
         self.req: Optional[Request] = None
         self.streamed = 0
+        self.cand_pos: Dict[int, int] = {}   # candidate -> tokens sent
 
 
 class ServeFrontend:
@@ -297,13 +307,24 @@ class ServeFrontend:
         while self._submit:
             stream = self._submit.popleft()
             p = stream.params
+            n_stream = p.get("n", 1)        # candidates the client sees
+
+            def _fork_cb(i, s=stream, n_stream=n_stream):
+                # candidates in [n, best_of) decode silently: they only
+                # compete in the best-of ranking, never reach the wire
+                if i >= n_stream:
+                    return None
+                return lambda tok, s=s, i=i: s.q.put(("token", tok, i))
+
             try:
                 req = self.engine.add_request(
                     p["prompt"], max_new_tokens=p["max_new_tokens"],
                     temperature=p["temperature"], top_k=p["top_k"],
                     seed=p["seed"], eos_id=p["eos_id"],
                     deadline_ms=p["deadline_ms"],
-                    callback=lambda tok, s=stream: s.q.put(("token", tok)))
+                    n=p.get("best_of", 1),
+                    fork_callback=_fork_cb,
+                    callback=lambda tok, s=stream: s.q.put(("token", tok, 0)))
                 stream.req = req
                 with self._lock:
                     self._active[req.req_id] = stream
@@ -312,20 +333,65 @@ class ServeFrontend:
         while self._cancel:
             stream = self._cancel.popleft()
             if stream.req is not None:
-                self.engine.cancel(stream.req)
+                # a disconnect tears down the WHOLE group: every
+                # candidate's block refs drop, shared prompt refcounts
+                # return to baseline
+                self.engine.cancel_group(stream.req)
                 with self._lock:
                     self._active.pop(stream.req.req_id, None)
 
+    @staticmethod
+    def _group_done(req: Request) -> bool:
+        """A stream's done frame goes out when its WHOLE group is
+        terminal: the primary plus every fork. Before the fork happens
+        (mid-prefill) only a cancellation is terminal — any other
+        finish implies the prefill completed, which forks first."""
+        if not req.finish_reason:
+            return False
+        if req.n_candidates == 1:
+            return True
+        if len(req.forks) < req.n_candidates - 1:
+            return req.finish_reason == "cancelled"
+        return all(f.finish_reason for f in req.forks)
+
+    @staticmethod
+    def _rank_group(req: Request) -> "tuple[int, list]":
+        """best-of-n ranking: mean per-token log-probability under each
+        candidate's own sampling distribution (sum would just prefer
+        short outputs). Ties break to the LOWEST candidate index, so
+        n == best_of degenerates deterministically to candidate 0's
+        behavior under greedy (all candidates identical)."""
+        cands = sorted([req] + req.forks, key=lambda r: r.cand_index)
+        infos = [{"index": r.cand_index,
+                  "tokens": ServeEngine._generated_of(r),
+                  "reason": r.finish_reason,
+                  "logprob": round(
+                      r.logprob_sum / max(1, len(r.generated)), 6)}
+                 for r in cands]
+        best = max(infos, key=lambda c: c["logprob"])
+        return best["index"], infos
+
     def _flush_finished(self) -> None:
-        """Push done frames for requests the last step finished."""
+        """Push done frames for request GROUPS the last step finished
+        (for n > 1 the frame waits until every candidate is done)."""
         with self._lock:
             done = [(rid, s) for rid, s in self._active.items()
-                    if s.req is not None and s.req.finish_reason]
+                    if s.req is not None and self._group_done(s.req)]
             for rid, _ in done:
                 del self._active[rid]
         for rid, s in done:
-            s.q.put(("done", s.req.finish_reason,
-                     ServeEngine._generated_of(s.req)))
+            if s.req.n_candidates == 1:
+                s.q.put(("done", s.req.finish_reason,
+                         ServeEngine._generated_of(s.req), None))
+            else:
+                best_idx, cands = self._rank_group(s.req)
+                best = cands[best_idx]
+                n_stream = s.params.get("n", 1)
+                s.q.put(("done", best["reason"], best["tokens"],
+                         {"best_index": best_idx,
+                          # silent best_of-only candidates stay
+                          # server-side; the wire sees n candidates
+                          "candidates": cands[:n_stream]}))
 
     def _drain_finished(self) -> bool:
         """True once every in-flight stream completed (or the deadline
@@ -345,10 +411,10 @@ class ServeFrontend:
             self._active.clear()
         for s in aborted:
             if s.req is not None:
-                self.engine.cancel(s.req)
+                self.engine.cancel_group(s.req)
                 if count_drain:
                     self._m_drain_cancelled.inc()
-            s.q.put(("done", "cancelled", []))
+            s.q.put(("done", "cancelled", [], None))
 
     # -- HTTP handlers ----------------------------------------------------
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
@@ -404,6 +470,14 @@ class ServeFrontend:
             if (not isinstance(prompt, list)
                     or not all(isinstance(t, int) for t in prompt)):
                 raise ValueError("prompt must be a list of token ids")
+            n = int(body.get("n", 1))
+            best_of = int(body.get("best_of", n))
+            if n < 1:
+                raise ValueError(f"n {n} < 1")
+            if best_of < n:
+                raise ValueError(
+                    f"best_of {best_of} < n {n}: the ranked pool must "
+                    "contain every returned candidate")
             return {
                 "prompt": prompt,
                 "max_new_tokens": int(body.get(
@@ -415,6 +489,8 @@ class ServeFrontend:
                 "deadline_ms": body.get("deadline_ms",
                                         self.default_deadline_ms),
                 "stream": bool(body.get("stream", True)),
+                "n": n,
+                "best_of": best_of,
             }
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(h, 400, "application/json",
@@ -491,17 +567,25 @@ class ServeFrontend:
                 continue
             try:
                 if item[0] == "token":
+                    _, tok, cand = item
+                    pos = stream.cand_pos.get(cand, 0)
+                    # `index` tags the CANDIDATE (parallel sampling);
+                    # `pos` is the token's position within that
+                    # candidate's stream
                     h.wfile.write(sse_event(
-                        {"token": item[1], "index": stream.streamed}))
+                        {"token": tok, "index": cand, "pos": pos}))
                     h.wfile.flush()
+                    stream.cand_pos[cand] = pos + 1
                     stream.streamed += 1
                 elif item[0] == "done":
-                    _, reason, tokens = item
-                    h.wfile.write(sse_event(
-                        {"done": True, "reason": reason,
-                         "tokens": tokens,
-                         "req_id": stream.req.req_id
-                         if stream.req else None}))
+                    _, reason, tokens, extra = item
+                    frame = {"done": True, "reason": reason,
+                             "tokens": tokens,
+                             "req_id": stream.req.req_id
+                             if stream.req else None}
+                    if extra is not None:
+                        frame.update(extra)
+                    h.wfile.write(sse_event(frame))
                     h.wfile.write(sse_event(DONE_SENTINEL))
                     h.wfile.flush()
                     return
@@ -530,13 +614,17 @@ class ServeFrontend:
                            b'{"error": "timed out"}\n')
                 return
             if item[0] == "token":
-                tokens.append(item[1])
+                if item[2] == 0:        # aggregate body reports best /
+                    tokens.append(item[1])   # candidate list, not a mix
             elif item[0] == "done":
-                _, reason, full = item
-                body = json.dumps({
+                _, reason, full, extra = item
+                payload = {
                     "tokens": full or tokens, "reason": reason,
                     "req_id": stream.req.req_id if stream.req else None,
-                }).encode() + b"\n"
+                }
+                if extra is not None:
+                    payload.update(extra)
+                body = json.dumps(payload).encode() + b"\n"
                 self._send(h, 200, "application/json", body)
                 return
             else:

@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-prefill-tokens", type=int, default=64)
     p.add_argument("--tile-q", type=int, default=8)
     p.add_argument("--no-prefix-cache", action="store_true")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative draft length (0 disables; > 0 "
+                        "turns on the n-gram self-drafter)")
     # front-end / admission / drain
     p.add_argument("--max-queue-depth", type=int, default=64)
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
@@ -79,7 +82,8 @@ def build_frontend(a: argparse.Namespace):
             a.model_dir, max_batch_size=a.max_batch_size,
             block_size=a.block_size, num_blocks=a.num_blocks,
             max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
-            enable_prefix_cache=not a.no_prefix_cache, registry=registry)
+            enable_prefix_cache=not a.no_prefix_cache,
+            spec_k=a.spec_k, registry=registry)
     else:
         import jax
         import jax.numpy as jnp
@@ -95,7 +99,8 @@ def build_frontend(a: argparse.Namespace):
             model, variables, max_batch_size=a.max_batch_size,
             block_size=a.block_size, num_blocks=a.num_blocks,
             max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
-            enable_prefix_cache=not a.no_prefix_cache, registry=registry)
+            enable_prefix_cache=not a.no_prefix_cache,
+            spec_k=a.spec_k, registry=registry)
     slo = SLOMonitor(
         registry,
         objectives=default_objectives(

@@ -60,12 +60,17 @@ def test_vgg_tiny():
     assert out.shape == (1, 5)
 
 
+@pytest.mark.slow   # tier-2: ~6s of compile for a pure shape smoke;
+                    # conv-stack coverage stays tier-1 via resnet/vgg
 def test_se_resnext_tiny():
     model = SEResNeXt(layers=(1, 1, 1, 1), cardinality=8, num_classes=6)
     _, out = _run(model, (1, 64, 64, 3))
     assert out.shape == (1, 6)
 
 
+@pytest.mark.slow   # tier-2: ~17s of compile (inception branches), the
+                    # suite's costliest shape smoke; funds the tier-1
+                    # budget for tests/test_spec_decode.py
 def test_googlenet_tiny():
     _, out = _run(GoogLeNet(num_classes=4), (1, 64, 64, 3))
     assert out.shape == (1, 4)

@@ -1,0 +1,452 @@
+"""Speculative decoding + parallel sampling tests (engine/ + serve/):
+the NgramDrafter on adversarial histories, fork/reserve semantics of
+the refcounted cache, exact output identity of speculative decode
+against plain decode (greedy AND temperature, including a drafter that
+is always wrong — the rejection-rollback path), the one-compile
+invariant with speculation on, best-of-n forking identity against solo
+runs, pool-leak checks across cancels and preemption, and the HTTP
+front-end's n / best_of surface (candidate-tagged SSE frames,
+disconnect cancels the whole group).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.engine import CacheExhausted, NgramDrafter, PagedKVCache
+from paddle_tpu.engine.engine import ServeEngine
+from paddle_tpu.models.transformer import CausalLM
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.serve.frontend import ServeFrontend
+from paddle_tpu.serve.sse import collect_stream, stream_completion
+
+pytestmark = pytest.mark.serve
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = CausalLM(vocab=VOCAB, model_dim=16, num_heads=4, num_layers=2,
+                     ffn_dim=32, dropout=0.0, max_len=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_prefill_tokens", 32)
+    kw.setdefault("tile_q", 4)
+    kw.setdefault("registry", MetricsRegistry())
+    return ServeEngine(model, variables, **kw)
+
+
+# a prompt whose continuation the model tends to copy: lookup-friendly
+REPEATY = [1, 2, 3, 4, 5, 1, 2, 3, 4, 5, 1, 2, 3]
+
+
+# -- drafter ---------------------------------------------------------------
+
+class TestNgramDrafter:
+    def test_no_match_proposes_nothing(self):
+        d = NgramDrafter(k=4, max_ngram=3)
+        assert d.propose([1, 2, 3, 4, 5, 6]) == []      # no repetition
+        assert d.propose([7]) == []                     # too short
+        assert d.propose([]) == []
+
+    def test_full_match_proposes_continuation(self):
+        d = NgramDrafter(k=4, max_ngram=3)
+        # trailing [1,2,3] matched at the start; continuation 4,5,6,7
+        assert d.propose([1, 2, 3, 4, 5, 6, 7, 1, 2, 3]) == [4, 5, 6, 7]
+
+    def test_repeated_ngram_picks_most_recent(self):
+        d = NgramDrafter(k=2, max_ngram=2)
+        # [1,2] occurs twice before the tail: at 0 (-> 9) and 3 (-> 8).
+        # The LATER occurrence wins.
+        assert d.propose([1, 2, 9, 1, 2, 8, 1, 2]) == [8, 1]
+
+    def test_longer_ngram_wins(self):
+        d = NgramDrafter(k=1, max_ngram=3)
+        # tail [5,1,2]: the 3-gram match (-> 7) must beat the shorter
+        # [1,2] match (-> 6)
+        assert d.propose([5, 1, 2, 7, 0, 1, 2, 6, 5, 1, 2]) == [7]
+
+    def test_full_window_beats_tail_flush_match(self):
+        d = NgramDrafter(k=4, max_ngram=3)
+        # a constant run: the match nearest the tail offers only the
+        # tail's leftovers, so an earlier occurrence with a full
+        # 4-token continuation must win
+        assert d.propose([5, 6, 7] + [20] * 8) == [20, 20, 20, 20]
+        # no occurrence fills the window -> longest continuation wins
+        d2 = NgramDrafter(k=8, max_ngram=2)
+        assert d2.propose([1, 2, 9, 9, 1, 2]) == [9, 9, 1, 2]
+
+    def test_cap_respected(self):
+        d = NgramDrafter(k=8, max_ngram=1)
+        hist = [3, 4, 5, 6, 3]
+        assert d.propose(hist, max_tokens=2) == [4, 5]
+        assert d.propose(hist, max_tokens=0) == []
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            NgramDrafter(k=0)
+        with pytest.raises(ValueError):
+            NgramDrafter(k=2, max_ngram=1, min_ngram=2)
+
+
+# -- cache fork / reservation ----------------------------------------------
+
+class TestCacheForkAndReserve:
+    def _cache(self, **kw):
+        kw.setdefault("num_layers", 1)
+        kw.setdefault("num_blocks", 16)
+        kw.setdefault("block_size", 4)
+        kw.setdefault("num_kv_heads", 1)
+        kw.setdefault("head_dim", 4)
+        return PagedKVCache(**kw)
+
+    def test_fork_shares_all_blocks(self):
+        c = self._cache()
+        c.alloc_sequence(0, list(range(10)))        # 3 blocks
+        used = c.used_blocks
+        c.fork_sequence(0, 1)
+        assert c.used_blocks == used                # zero new blocks
+        assert c.block_table(1) == c.block_table(0)
+        for b in c.block_table(0):
+            assert c.ref_count(b) == 2
+        with pytest.raises(ValueError):
+            c.fork_sequence(0, 1)                   # dst exists
+
+    def test_free_fork_only_drops_exclusive_blocks(self):
+        c = self._cache()
+        c.alloc_sequence(0, list(range(10)))
+        c.fork_sequence(0, 1)
+        # diverge: the fork writes its own token -> COW tail + append
+        c.reserve_slots(1, 1)
+        c.advance(1, 99)
+        forked_tail = c.block_table(1)[-1]
+        assert c.ref_count(forked_tail) == 1        # private copy
+        shared = c.block_table(0)
+        c.free_sequence(1)
+        # the primary's blocks must all survive with refcount 1
+        assert c.block_table(0) == shared
+        for b in shared:
+            assert c.ref_count(b) == 1
+        c.free_sequence(0)
+        assert c.used_blocks == 0
+        c.assert_quiesced()
+
+    def test_fork_divergence_cows_shared_tail(self):
+        c = self._cache()
+        c.alloc_sequence(0, list(range(6)))         # tail block half full
+        tail = c.block_table(0)[-1]
+        c.fork_sequence(0, 1)
+        c.reserve_slots(1, 1)
+        # fork's tail was COWed off the shared block; primary untouched
+        assert c.block_table(1)[-1] != tail
+        assert c.block_table(0)[-1] == tail
+        assert c.ref_count(tail) == 1
+        assert c.drain_copies() != []               # device copy queued
+
+    def test_reserve_slots_all_or_nothing(self):
+        c = self._cache(num_blocks=4)               # 3 usable blocks
+        c.alloc_sequence(0, list(range(8)))         # uses 2
+        table = list(c.block_table(0))
+        free = c.free_blocks
+        # 6 more slots need 2 fresh blocks; only 1 free -> must raise
+        # BEFORE mutating anything
+        with pytest.raises(CacheExhausted):
+            c.reserve_slots(0, 6)
+        assert c.block_table(0) == table
+        assert c.free_blocks == free
+        # a fitting reservation still works afterwards
+        slots = c.reserve_slots(0, 4)
+        assert len(slots) == 4
+
+    def test_reserve_slots_spans_blocks(self):
+        c = self._cache()
+        c.alloc_sequence(0, list(range(3)))
+        slots = c.reserve_slots(0, 3)               # 3..5: crosses block 0->1
+        bs = c.block_size
+        assert [s % bs for s in slots] == [3, 0, 1]
+        # positions map to the table the engine will scatter through
+        for j, s in enumerate(slots):
+            assert s == c.slot_of(0, 3 + j)
+
+
+# -- speculative decode: identity + rollback -------------------------------
+
+class _WrongDrafter:
+    """Adversarial drafter: always proposes k tokens the model will
+    reject (off-by-one of the last token, mod vocab) — every window
+    exercises the rejection-rollback path."""
+
+    def __init__(self, k=3):
+        self.k = k
+
+    def propose(self, tokens, max_tokens=None):
+        cap = self.k if max_tokens is None else min(self.k, max_tokens)
+        if cap < 1:
+            return []
+        t = (tokens[-1] + 1) % VOCAB
+        return [t] * cap
+
+
+class TestSpeculativeDecode:
+    def test_greedy_identical_to_plain_decode(self, model_and_vars):
+        model, variables = model_and_vars
+        prompts = [list(REPEATY), [9, 8, 7, 9, 8, 7, 9, 8],
+                   [4, 4, 4, 4, 4, 4]]
+        base = _engine(model, variables)
+        refs = base.generate(prompts, max_new_tokens=16)
+        spec = _engine(model, variables, spec_k=4)
+        outs = spec.generate(prompts, max_new_tokens=16)
+        assert outs == refs
+        assert spec._step_fn._cache_size() == 1
+        assert spec._m_spec_drafted.value > 0
+
+    def test_greedy_identical_with_chunked_prefill(self, model_and_vars):
+        model, variables = model_and_vars
+        prompts = [list(REPEATY) * 2, [2, 3] * 8]   # > chunk budget of 8
+        base = _engine(model, variables, max_prefill_tokens=8)
+        refs = base.generate(prompts, max_new_tokens=12)
+        spec = _engine(model, variables, max_prefill_tokens=8, spec_k=3)
+        assert spec.generate(prompts, max_new_tokens=12) == refs
+        assert spec._step_fn._cache_size() == 1
+
+    def test_temperature_identical(self, model_and_vars):
+        model, variables = model_and_vars
+        base = _engine(model, variables)
+        r0 = base.add_request(list(REPEATY), max_new_tokens=16,
+                              temperature=0.7, seed=11)
+        ref = base.run()[r0.req_id]
+        spec = _engine(model, variables, spec_k=4)
+        r1 = spec.add_request(list(REPEATY), max_new_tokens=16,
+                              temperature=0.7, seed=11)
+        assert spec.run()[r1.req_id] == ref
+
+    def test_rejection_rollback_exactness(self, model_and_vars):
+        """A drafter that is ALWAYS wrong forces a full rollback every
+        step; output must still be bit-identical to plain decode and
+        every drafted token must count as rejected."""
+        model, variables = model_and_vars
+        prompts = [list(REPEATY), [6, 5, 4, 3, 2, 1]]
+        base = _engine(model, variables)
+        refs = base.generate(prompts, max_new_tokens=14)
+        spec = _engine(model, variables, drafter=_WrongDrafter(k=3))
+        assert spec.generate(prompts, max_new_tokens=14) == refs
+        assert spec._m_spec_rejected.value > 0
+        assert spec._m_spec_accepted.value == 0
+        assert (spec._m_spec_drafted.value
+                == spec._m_spec_rejected.value)
+
+    def test_one_compile_with_speculation_on(self, model_and_vars):
+        """test_one_compile_for_mixed_traffic variant: arbitrary mixed
+        traffic with speculation enabled never adds a compile — draft
+        length changes are operand changes, not shape changes."""
+        model, variables = model_and_vars
+        eng = _engine(model, variables, max_prefill_tokens=8, spec_k=4)
+        eng.add_request(list(REPEATY) * 2, max_new_tokens=10)
+        eng.add_request([1, 2], max_new_tokens=6, temperature=0.5, seed=3)
+        for _ in range(4):
+            eng.step()
+        eng.add_request([8, 8, 8, 8, 8, 8, 8, 8, 8], max_new_tokens=8)
+        eng.run()
+        assert eng._step_fn._cache_size() == 1
+        assert eng._m_compiles.value == 1.0
+        assert eng.cache.occupancy() == 0.0
+
+    def test_speculation_reduces_steps(self, model_and_vars):
+        """On a lookup-friendly prompt, accepted drafts must shrink
+        steps below one-per-token."""
+        model, variables = model_and_vars
+        prompt = [1, 2, 3] * 6
+        base = _engine(model, variables)
+        r0 = base.add_request(list(prompt), max_new_tokens=24)
+        ref = base.run()[r0.req_id]
+        spec = _engine(model, variables, spec_k=4)
+        r1 = spec.add_request(list(prompt), max_new_tokens=24)
+        assert spec.run()[r1.req_id] == ref
+        assert spec._m_spec_accepted.value > 0
+        assert spec.steps < base.steps
+
+    def test_spec_drops_draft_when_pool_tight(self, model_and_vars):
+        """A pool too small for the whole window falls back to plain
+        decode (never preempts a neighbor for a draft) — output
+        identical, engine completes."""
+        model, variables = model_and_vars
+        base = _engine(model, variables)
+        refs = base.generate([list(REPEATY)], max_new_tokens=16)
+        # 8 usable blocks = exactly the final 29-token sequence: draft
+        # windows that need a block beyond that hit CacheExhausted and
+        # the scheduler plans plain decode rows instead
+        spec = _engine(model, variables, num_blocks=9, spec_k=4)
+        assert spec.generate([list(REPEATY)], max_new_tokens=16) == refs
+        assert spec.cache.occupancy() == 0.0
+
+
+# -- parallel sampling / best-of-n -----------------------------------------
+
+class TestParallelSampling:
+    def test_candidates_match_solo_runs(self, model_and_vars):
+        model, variables = model_and_vars
+        prompt = [7, 8, 9, 10, 11, 12, 13, 14]
+        grp = _engine(model, variables)
+        r = grp.add_request(list(prompt), max_new_tokens=10,
+                            temperature=0.8, seed=5, n=3)
+        res = grp.run()
+        assert len(r.forks) == 2
+        by_index = {0: res[r.req_id]}
+        for f in r.forks:
+            by_index[f.cand_index] = res[f.req_id]
+        for i in range(3):
+            solo = _engine(model, variables)
+            rs = solo.add_request(list(prompt), max_new_tokens=10,
+                                  temperature=0.8, seed=5 + i)
+            assert solo.run()[rs.req_id] == by_index[i], f"candidate {i}"
+        assert grp.cache.occupancy() == 0.0
+        grp.cache.assert_quiesced()
+
+    def test_fork_shares_prompt_blocks(self, model_and_vars):
+        model, variables = model_and_vars
+        eng = _engine(model, variables)
+        r = eng.add_request([3] * 8, max_new_tokens=8, temperature=0.3,
+                            seed=1, n=4)
+        while not r.forks:
+            eng.step()
+        assert eng.cache.shared_blocks >= 2         # whole prompt shared
+        eng.run()
+        assert eng.cache.occupancy() == 0.0
+
+    def test_group_cancel_and_preemption_leak_check(self, model_and_vars):
+        """Pool occupancy must return to zero after n-best with a
+        mid-flight cancel_group AND a pool small enough to force
+        preemption of group members."""
+        model, variables = model_and_vars
+        # 15 usable blocks; 3 candidates x 28 tokens needs ~17: preempts
+        eng = _engine(model, variables, num_blocks=16)
+        victim = eng.add_request([5, 6, 7, 8, 5, 6, 7, 8],
+                                 max_new_tokens=20, temperature=0.4,
+                                 seed=2, n=3)
+        for _ in range(5):
+            eng.step()
+        assert len(victim.forks) == 2
+        cancelled = eng.cancel_group(victim)
+        assert cancelled == 3
+        survivor = eng.add_request([9, 9, 9, 9, 9, 9, 9, 9],
+                                   max_new_tokens=20, temperature=0.4,
+                                   seed=7, n=3)
+        eng.run()
+        assert survivor.finish_reason
+        assert all(f.finish_reason for f in survivor.forks)
+        assert eng.cache.occupancy() == 0.0
+        eng.cache.assert_quiesced()
+
+    def test_spec_and_forks_compose(self, model_and_vars):
+        """Speculation verifies forked candidates too; identity against
+        a spec-off group run holds per candidate."""
+        model, variables = model_and_vars
+        prompt = [1, 2, 3, 1, 2, 3, 1, 2]
+        base = _engine(model, variables)
+        rb = base.add_request(list(prompt), max_new_tokens=12, n=2)
+        res_b = base.run()
+        spec = _engine(model, variables, spec_k=3)
+        rs = spec.add_request(list(prompt), max_new_tokens=12, n=2)
+        res_s = spec.run()
+        assert res_s[rs.req_id] == res_b[rb.req_id]
+        assert (res_s[rs.forks[0].req_id]
+                == res_b[rb.forks[0].req_id])
+        assert spec._step_fn._cache_size() == 1
+        assert spec.cache.occupancy() == 0.0
+
+    def test_n_validation(self, model_and_vars):
+        model, variables = model_and_vars
+        eng = _engine(model, variables)
+        with pytest.raises(ValueError):
+            eng.add_request([1, 2], n=0)
+        with pytest.raises(ValueError):
+            eng.add_request([1, 2], n=eng.max_batch_size + 1)
+
+
+# -- HTTP front-end: n / best_of -------------------------------------------
+
+class TestFrontendNBest:
+    @pytest.fixture()
+    def fe(self, model_and_vars):
+        model, variables = model_and_vars
+        front = ServeFrontend(_engine(model, variables),
+                              drain_deadline_s=10.0).start()
+        yield front
+        front.stop()
+
+    def test_n_streams_tagged_candidates(self, fe):
+        out = collect_stream(fe.url, {
+            "prompt": [4, 5, 6, 7], "max_new_tokens": 6,
+            "temperature": 0.6, "seed": 9, "n": 2})
+        assert out["status"] == 200 and out["done"]
+        final = out["final"]
+        assert {c["index"] for c in final["candidates"]} == {0, 1}
+        for c in final["candidates"]:
+            assert len(c["tokens"]) == 6 and c["reason"] == "length"
+        assert final["tokens"] == \
+            final["candidates"][final["best_index"]]["tokens"]
+
+    def test_frames_carry_candidate_index_and_pos(self, fe):
+        s = stream_completion(fe.url, {
+            "prompt": [2, 3, 4, 5], "max_new_tokens": 5,
+            "temperature": 0.5, "seed": 3, "n": 2})
+        per_cand = {}
+        for ev in s.events():
+            if "token" in ev:
+                assert ev["pos"] == per_cand.get(ev["index"], 0)
+                per_cand[ev["index"]] = ev["pos"] + 1
+        assert s.done and per_cand == {0: 5, 1: 5}
+
+    def test_best_of_decodes_silently(self, fe):
+        """best_of > n: extra candidates rank but never hit the wire."""
+        out = collect_stream(fe.url, {
+            "prompt": [8, 7, 6, 5], "max_new_tokens": 4,
+            "temperature": 0.7, "seed": 1, "n": 1, "best_of": 3})
+        assert out["status"] == 200 and out["done"]
+        final = out["final"]
+        assert [c["index"] for c in final["candidates"]] == [0]
+        assert len(out["tokens"]) == 4              # only candidate 0's
+        assert fe.engine.cache.occupancy() == 0.0
+
+    def test_bad_n_rejected(self, fe):
+        assert collect_stream(fe.url, {"prompt": [1], "n": 0})[
+            "status"] == 400
+        assert collect_stream(fe.url, {
+            "prompt": [1], "n": 3, "best_of": 2})["status"] == 400
+
+    def test_disconnect_cancels_all_forks(self, fe):
+        """Mid-stream disconnect with n=3: every candidate cancels,
+        all refcounts (shared prompt blocks included) return to
+        baseline."""
+        import time as _time
+        eng = fe.engine
+        baseline = eng.cache.occupancy()
+        s = stream_completion(fe.url, {
+            "prompt": [7, 7, 7, 7, 1, 2, 3, 4], "max_new_tokens": 40,
+            "temperature": 0.5, "seed": 4, "n": 3})
+        it = s.events()
+        next(it)                                    # first token arrived:
+        s.close()                                   # forks exist; hang up
+        deadline = _time.monotonic() + 10
+        want = 3.0
+        reqs = eng.obs.get("ptpu_serve_requests_total")
+        while _time.monotonic() < deadline:
+            if reqs.labels(reason="cancelled").value == want:
+                break
+            _time.sleep(0.02)
+        assert reqs.labels(reason="cancelled").value == want
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            if eng.cache.occupancy() == baseline:
+                break
+            _time.sleep(0.02)
+        assert eng.cache.occupancy() == baseline
+        eng.cache.assert_quiesced()

@@ -17,6 +17,15 @@ Sweeps (block_size x num_blocks) cells and reports, per cell:
 - tok_s_ceiling: --hbm-gbps / bytes_per_token — the best any kernel
               can do at that context length on this rig.
 
+`--spec-k K1,K2,...` appends one column per K modelling speculative
+decoding's amortization: a verification step streams the SAME context
+bytes as a plain decode step (the window rides the existing per-row
+tile, so the kernel's streamed bytes don't grow with K), but emits
+E = (1-a^(K+1))/(1-a) tokens in expectation at per-token acceptance
+`--spec-accept a` (K+1 when a == 1). Effective bytes/emitted-token =
+bytes_per_token / E, so the emitted-token ceiling scales by E. Output
+is unchanged when the flag is absent.
+
 Default run is a CPU smoke: prints the analytic sweep and validates the
 ragged kernel end-to-end in interpret mode on one tiny cell (finite
 output, matches the XLA reference). `--rig` additionally times the
@@ -26,6 +35,7 @@ GB/s against --hbm-gbps.
 
 Run: python tools/paged_roofline.py [--rig] [--block-sizes 8,16,32]
      [--num-blocks 512,2048,8192] [--hbm-gb 16 --hbm-gbps 819]
+     [--spec-k 2,4,8 --spec-accept 0.7]
 """
 
 import argparse
@@ -49,6 +59,16 @@ def decode_bytes_per_token(layers, ctx, block_size, kv_heads, head_dim,
     blocks = -(-ctx // block_size)
     return layers * 2 * blocks * block_size * kv_heads * head_dim \
         * dtype_bytes
+
+
+def expected_emitted(spec_k, accept):
+    """Expected tokens emitted per verification step with a K-token
+    draft at i.i.d. per-token acceptance `accept`: the accepted prefix
+    length is geometric, truncated at K, plus the one token the step
+    always emits — sum_{j=0..K} accept^j = (1-a^(K+1))/(1-a)."""
+    if accept >= 1.0:
+        return float(spec_k + 1)
+    return (1.0 - accept ** (spec_k + 1)) / (1.0 - accept)
 
 
 def _ragged_decode_operands(batch, ctx, block_size, num_blocks, heads,
@@ -141,6 +161,12 @@ def main():
                     help="rig HBM bandwidth (v5e datasheet: 819 GB/s)")
     ap.add_argument("--rig", action="store_true",
                     help="time the real kernel on the TPU per cell")
+    ap.add_argument("--spec-k", default=None, metavar="K1,K2,...",
+                    help="append an emitted-token ceiling column per "
+                    "speculative draft length K")
+    ap.add_argument("--spec-accept", type=float, default=0.7,
+                    help="modelled per-token draft acceptance "
+                    "probability for the --spec-k columns")
     args = ap.parse_args()
 
     if args.rig:
@@ -148,14 +174,24 @@ def main():
 
     block_sizes = [int(s) for s in args.block_sizes.split(",")]
     num_blocks = [int(s) for s in args.num_blocks.split(",")]
+    spec_ks = ([int(s) for s in args.spec_k.split(",")]
+               if args.spec_k else [])
     L, Hkv, Dh = args.layers, args.kv_heads, args.head_dim
 
     print(f"model: {L} layers, {args.heads} heads ({Hkv} kv), "
           f"head_dim {Dh}, bf16 pool; rig: {args.hbm_gb:.0f} GB HBM "
           f"@ {args.hbm_gbps:.0f} GB/s; batch {args.batch}")
+    if spec_ks:
+        print(f"spec columns: emitted-token ceiling at per-token "
+              f"acceptance {args.spec_accept:.2f} "
+              f"(E[emitted] = "
+              + ", ".join(f"k={k}: {expected_emitted(k, args.spec_accept):.2f}"
+                          for k in spec_ks) + ")")
     hdr = (f"{'BS':>4} {'NB':>6} {'pool_gb':>8} {'%hbm':>6} "
            f"{'cap_tok':>8} {'ctx/row':>8} {'KB/tok':>8} "
            f"{'tok_s_ceil':>10}")
+    for k in spec_ks:
+        hdr += f" {f'spec_k={k}':>10}"
     if args.rig:
         hdr += f" {'kern_ms':>8} {'GB/s':>7} {'%bw':>5}"
     print(hdr)
@@ -172,6 +208,8 @@ def main():
             line = (f"{bs:>4} {nb:>6} {pool/1e9:>8.3f} {frac*100:>5.1f}% "
                     f"{cap:>8} {ctx:>8} {bpt/1e3:>8.1f} "
                     f"{ceil_tok:>10.0f}")
+            for k in spec_ks:
+                line += (f" {ceil_tok * expected_emitted(k, args.spec_accept):>10.0f}")
             if frac > 1.0:
                 line += "  (exceeds HBM -- skipped)"
                 print(line)
