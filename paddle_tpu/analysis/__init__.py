@@ -1,0 +1,40 @@
+"""graftlint — AST-based invariant checker for this repo's own source.
+
+Four passes enforce the contracts the runtime tests only sample:
+
+* trace purity / recompile hazards (TP00x) — nothing host-visible
+  inside jit-traced code; the compile cache stays at one entry.
+* lock discipline (LK00x) — ``# guarded-by:`` attributes are written
+  under their lock; no blocking calls or acquisition-order cycles
+  while holding one.
+* telemetry schema (TS00x) — code and OBSERVABILITY.md agree on every
+  ``ptpu_*`` series, label set, and event stream; label values stay
+  bounded.
+* error hygiene (EH00x) — no bare asserts in library code, no silent
+  daemon threads, no tracebacks dropped by error logs.
+
+Run ``python -m paddle_tpu.analysis paddle_tpu tools`` (see ANALYSIS.md);
+the tier-1 gate is ``tests/test_analysis.py`` against
+``analysis_baseline.txt``.
+"""
+from .core import (  # noqa: F401
+    Finding,
+    RULES,
+    SourceFile,
+    apply_baseline,
+    format_baseline,
+    load_baseline,
+    load_files,
+    run_analysis,
+)
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "SourceFile",
+    "apply_baseline",
+    "format_baseline",
+    "load_baseline",
+    "load_files",
+    "run_analysis",
+]

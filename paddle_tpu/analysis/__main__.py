@@ -1,0 +1,110 @@
+"""graftlint CLI: ``python -m paddle_tpu.analysis [--json] [paths...]``.
+
+Exit codes: 0 clean (after baseline), 1 findings (or stale baseline
+entries), 2 usage error.  Output is sorted (file, line, rule) so runs
+diff cleanly; ``--json`` emits one stable JSON document on stdout.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+from .core import (RULES, Finding, apply_baseline, format_baseline,
+                   load_baseline, run_analysis)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_PATHS = ["paddle_tpu", "tools"]
+DEFAULT_BASELINE = "analysis_baseline.txt"
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="AST-based invariant checker (trace purity, lock "
+                    "discipline, telemetry schema, error hygiene). "
+                    "See ANALYSIS.md.")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/dirs to analyze (default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a stable JSON document")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help=f"baseline file (default: <repo>/{DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to accept all current findings")
+    ap.add_argument("--rules", default=None, metavar="R1,R2",
+                    help="restrict to a comma-separated rule-id subset")
+    ap.add_argument("--doc", default=None, metavar="OBSERVABILITY.md",
+                    help="series-inventory doc for the telemetry pass")
+    ap.add_argument("--root", default=None, metavar="DIR",
+                    help="repo root for relative paths (default: autodetect)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(RULES):
+            print(f"{rule}  {RULES[rule]}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else REPO_ROOT
+    paths = args.paths or [os.path.join(root, p) for p in DEFAULT_PATHS]
+    paths = [p for p in paths if os.path.exists(p)]
+    if not paths:
+        print("graftlint: no analyzable paths", file=sys.stderr)
+        return 2
+
+    rules = None
+    if args.rules:
+        rules = {r.strip().upper() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"graftlint: unknown rules: {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    findings = run_analysis(paths, root, doc_path=args.doc, rules=rules)
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.update_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            fh.write(format_baseline(findings))
+        print(f"graftlint: baseline updated with {len(findings)} finding(s) "
+              f"-> {os.path.relpath(baseline_path)}")
+        return 0
+
+    suppressed = 0
+    stale: List[str] = []
+    if not args.no_baseline:
+        baseline = load_baseline(baseline_path)
+        findings, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.as_json:
+        doc = {
+            "findings": [f.to_json() for f in findings],
+            "suppressed": suppressed,
+            "stale_baseline": stale,
+            "ok": not findings and not stale,
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.render())
+        for key in stale:
+            print(f"stale-baseline: {key} (fixed? remove it from the baseline)")
+        tail = f"{len(findings)} finding(s)"
+        if suppressed:
+            tail += f", {suppressed} baselined"
+        if stale:
+            tail += f", {len(stale)} stale baseline entr(y/ies)"
+        print(f"graftlint: {tail}")
+
+    return 1 if (findings or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

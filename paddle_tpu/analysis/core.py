@@ -1,0 +1,383 @@
+"""graftlint core: source model, annotations, findings, baseline.
+
+The analysis suite is pure stdlib (``ast`` + ``tokenize``) so it can run
+in CI containers with nothing installed beyond Python itself.  Passes
+live in sibling modules (trace_purity, locks, telemetry, hygiene); this
+module owns everything they share:
+
+* ``SourceFile`` — parsed AST plus a tokenize-derived comment map (a
+  regex over raw lines would mis-fire on ``#`` inside string literals),
+  and the annotation conventions extracted from those comments:
+
+  - ``# guarded-by: <lock-expr>`` on an attribute assignment line binds
+    that attribute to the lock for the enclosing class.
+  - ``# requires-lock: <lock-expr>`` on (or directly above) a ``def``
+    declares that callers hold the lock, so writes inside the function
+    body are considered protected.
+  - ``# graftlint: disable=RULE[,RULE...]`` waives findings on that line.
+  - ``# graftlint: skip-file=RULE[,RULE...]`` (anywhere in the file)
+    waives a rule for the whole file; ``skip-file=*`` skips the file.
+
+* ``Finding`` — one diagnostic, with a line-number-insensitive baseline
+  key (``relpath::RULE::stripped-source-line``) so accepted findings
+  survive unrelated edits above them.
+
+* The baseline file format and the top-level ``run_analysis`` driver.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Rule registry (ids -> one-line description; ANALYSIS.md holds the details)
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, str] = {
+    "TP001": "host-effect call inside jit-traced code (runs at trace time only)",
+    "TP002": "host materialization of a traced value (.item()/np.asarray/float())",
+    "TP003": "Python-level branch on a traced value (trace-time constant branch)",
+    "TP004": "jax.jit constructed per call (new cache entry every invocation)",
+    "LK001": "write to a guarded-by attribute outside its lock",
+    "LK002": "lock-acquisition-order cycle between classes",
+    "LK003": "blocking call while holding a lock",
+    "TS001": "metric series not documented in OBSERVABILITY.md",
+    "TS002": "documented metric series never registered in code",
+    "TS003": "metric kind/label-set disagrees with OBSERVABILITY.md",
+    "TS004": "unbounded label cardinality (dynamic value passed to .labels())",
+    "TS005": "emit_event stream not in the documented stream set",
+    "EH001": "bare assert in library (non-test) code — stripped under -O",
+    "EH002": "daemon-thread loop swallows exceptions without logging",
+    "EH003": "log.error in except handler without exc_info",
+    "XX000": "file failed to parse",
+}
+
+#: Streams documented in OBSERVABILITY.md's "Event streams" section.
+KNOWN_EVENT_STREAMS = frozenset({"serve", "resilience", "obs"})
+
+_GUARDED_BY_RE = re.compile(r"guarded-by:\s*([A-Za-z_][\w.]*)")
+_REQUIRES_LOCK_RE = re.compile(r"requires-lock:\s*([A-Za-z_][\w.]*)")
+_DISABLE_RE = re.compile(r"graftlint:\s*disable=([\w*,]+)")
+_SKIP_FILE_RE = re.compile(r"graftlint:\s*skip-file=([\w*,]+)")
+
+
+# ---------------------------------------------------------------------------
+# Findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic emitted by a pass."""
+
+    file: str  # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+    snippet: str = ""  # stripped source line, used for the baseline key
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.rule} {self.message}"
+
+    def baseline_key(self) -> str:
+        return f"{self.file}::{self.rule}::{self.snippet}"
+
+    def sort_key(self) -> Tuple[str, int, str, str]:
+        return (self.file, self.line, self.rule, self.message)
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Source model
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as ``a.b.c``; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def expr_text(node: ast.AST) -> str:
+    """Best-effort compact source text for an expression (for messages)."""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return dotted_name(node) or "<expr>"
+
+
+class SourceFile:
+    """A parsed module plus its comment-borne annotations."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[Finding] = None
+        #: lineno -> full comment text (without leading '#')
+        self.comments: Dict[int, str] = {}
+        #: lineno -> set of rule ids disabled on that line ('*' == all)
+        self.disabled: Dict[int, Set[str]] = {}
+        #: rule ids disabled for the whole file ('*' == skip entirely)
+        self.skip_rules: Set[str] = set()
+        #: (class qualname or '') -> {attr -> (lock expr text, decl lineno)}
+        self.guards: Dict[str, Dict[str, Tuple[str, int]]] = {}
+        #: lineno of a def -> lock expr the caller is declared to hold
+        self.requires_lock: Dict[int, str] = {}
+
+        try:
+            self.tree = ast.parse(text)
+        except SyntaxError as exc:
+            self.parse_error = Finding(
+                self.rel, exc.lineno or 1, "XX000", f"syntax error: {exc.msg}",
+                self.snippet(exc.lineno or 1))
+            return
+        self._scan_comments()
+        self._bind_annotations()
+
+    # -- helpers ----------------------------------------------------------
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, lineno: int, rule: str, message: str) -> Finding:
+        return Finding(self.rel, lineno, rule, message, self.snippet(lineno))
+
+    def is_test_code(self) -> bool:
+        rel = self.rel
+        if "analysis_fixtures" in rel:
+            return False  # fixtures simulate LIBRARY code on purpose
+        base = os.path.basename(rel)
+        return (
+            rel.startswith("tests/")
+            or "/tests/" in rel
+            or base.startswith("test_")
+            or base == "conftest.py"
+        )
+
+    # -- comment + annotation extraction ----------------------------------
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#").strip()
+        except (tokenize.TokenError, IndentationError):
+            pass
+        for lineno, comment in self.comments.items():
+            m = _DISABLE_RE.search(comment)
+            if m:
+                self.disabled[lineno] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            m = _SKIP_FILE_RE.search(comment)
+            if m:
+                self.skip_rules |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+    def _bind_annotations(self) -> None:
+        if self.tree is None:
+            return
+        class_stack: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.ClassDef):
+                class_stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                class_stack.pop()
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for probe in (node.lineno, node.lineno - 1):
+                    comment = self.comments.get(probe, "")
+                    m = _REQUIRES_LOCK_RE.search(comment)
+                    if m:
+                        self.requires_lock[node.lineno] = m.group(1)
+                        break
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                comment = self.comments.get(node.lineno, "")
+                m = _GUARDED_BY_RE.search(comment)
+                if m:
+                    lock = m.group(1)
+                    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                    for tgt in targets:
+                        attr = self._self_attr(tgt)
+                        if attr:
+                            owner = class_stack[-1] if class_stack else ""
+                            self.guards.setdefault(owner, {})[attr] = (lock, node.lineno)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(self.tree)
+
+    @staticmethod
+    def _self_attr(target: ast.AST) -> Optional[str]:
+        if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            return target.attr
+        return None
+
+    # -- suppression ------------------------------------------------------
+
+    def is_disabled(self, lineno: int, rule: str) -> bool:
+        if "*" in self.skip_rules or rule in self.skip_rules:
+            return True
+        rules = self.disabled.get(lineno)
+        return bool(rules) and ("*" in rules or rule in rules)
+
+
+# ---------------------------------------------------------------------------
+# File discovery / loading
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".pytest_cache", "node_modules", ".venv"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def load_files(paths: Sequence[str], root: str) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    seen: Set[str] = set()
+    for path in iter_py_files(paths):
+        abspath = os.path.abspath(path)
+        if abspath in seen:
+            continue
+        seen.add(abspath)
+        try:
+            rel = os.path.relpath(abspath, root)
+        except ValueError:  # different drive (windows); keep absolute
+            rel = abspath
+        if rel.startswith(".."):
+            rel = abspath
+        try:
+            with open(abspath, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        files.append(SourceFile(abspath, rel, text))
+    return files
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Baseline file -> multiset of accepted finding keys."""
+    counts: Dict[str, int] = {}
+    if not os.path.exists(path):
+        return counts
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.rstrip("\n")
+            if not line or line.startswith("#"):
+                continue
+            counts[line] = counts.get(line, 0) + 1
+    return counts
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[str, int]
+) -> Tuple[List[Finding], int, List[str]]:
+    """Split findings into (new, suppressed_count, stale_baseline_entries)."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        key = f.baseline_key()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            suppressed += 1
+        else:
+            new.append(f)
+    stale = sorted(k for k, n in budget.items() if n > 0 for _ in range(n))
+    return new, suppressed, stale
+
+
+def format_baseline(findings: Sequence[Finding]) -> str:
+    header = (
+        "# graftlint baseline — accepted findings, one key per line.\n"
+        "# Key format: relpath::RULE::stripped-source-line (line-number free,\n"
+        "# so edits above a finding don't invalidate it).  Regenerate with:\n"
+        "#   python -m paddle_tpu.analysis --update-baseline paddle_tpu tools\n"
+        "# Remove lines as findings are fixed; the gate flags stale entries.\n"
+    )
+    keys = sorted(f.baseline_key() for f in findings)
+    return header + "".join(k + "\n" for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def run_analysis(
+    paths: Sequence[str],
+    root: str,
+    doc_path: Optional[str] = None,
+    rules: Optional[Set[str]] = None,
+) -> List[Finding]:
+    """Run every pass over ``paths``; returns findings sorted and de-waived.
+
+    ``doc_path`` points at the OBSERVABILITY.md inventory for the
+    telemetry pass; defaults to ``<root>/OBSERVABILITY.md`` when present.
+    ``rules`` optionally restricts output to a subset of rule ids.
+    """
+    from . import hygiene, locks, telemetry, trace_purity
+
+    files = load_files(paths, root)
+    findings: List[Finding] = [f.parse_error for f in files if f.parse_error]
+    live = [f for f in files if f.tree is not None]
+
+    if doc_path is None:
+        candidate = os.path.join(root, "OBSERVABILITY.md")
+        doc_path = candidate if os.path.exists(candidate) else ""
+
+    findings.extend(trace_purity.run(live))
+    findings.extend(locks.run(live))
+    findings.extend(telemetry.run(live, doc_path, root))
+    findings.extend(hygiene.run(live))
+
+    by_rel = {f.rel: f for f in files}
+    kept: List[Finding] = []
+    for f in findings:
+        if rules is not None and f.rule not in rules:
+            continue
+        sf = by_rel.get(f.file)
+        if sf is not None and sf.is_disabled(f.line, f.rule):
+            continue
+        kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    return kept

@@ -1,0 +1,134 @@
+"""Error-hygiene pass (rules EH001-EH003).
+
+* EH001 — bare ``assert`` in library (non-test) code.  ``python -O``
+  strips asserts, so invariants guarded that way silently vanish in
+  optimized deployments; library code raises explicit exceptions.
+  Test files, ``testing/`` harness helpers and fixtures are exempt.
+
+* EH002 — a daemon-thread run loop that swallows exceptions:
+  ``except Exception/BaseException`` (or bare ``except``) whose handler
+  neither re-raises nor logs/emits anything.  A crashed-but-silent
+  scrape or snapshot thread looks exactly like a healthy idle one.
+  Only functions that are plausibly thread targets are checked: passed
+  as ``target=`` to ``threading.Thread`` in the same file, or named
+  ``*_loop`` / ``_run`` / ``run``.
+
+* EH003 — ``log.error(...)`` inside an ``except`` handler without
+  ``exc_info=`` and without a ``raise`` in the same handler: the one
+  place a traceback exists and the log line throws it away.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence, Set
+
+from .core import Finding, SourceFile, dotted_name
+
+_LOGGERISH = {"log", "logger", "logging", "_log", "_logger", "_LOGGER"}
+_EVENT_FNS = {"emit_event", "serve_event", "resilience_event", "obs_event",
+              "vlog", "warn", "warning", "error", "exception", "print"}
+_THREAD_TARGET_NAME_HINTS = ("_run", "run", "_loop")
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not sf.is_test_code():
+            _check_asserts(sf, findings)
+            _check_daemon_swallows(sf, findings)
+        _check_error_logs(sf, findings)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _check_asserts(sf: SourceFile, findings: List[Finding]) -> None:
+    if "/testing/" in sf.rel or sf.rel.startswith("testing/"):
+        return  # the op-test harness is test infrastructure by charter
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assert):
+            findings.append(sf.finding(
+                node.lineno, "EH001",
+                "bare assert in library code — stripped under python -O; "
+                "raise an explicit exception"))
+
+
+def _thread_targets(sf: SourceFile) -> Set[str]:
+    """Names passed as Thread(target=...) in this file."""
+    targets: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            if not fname.endswith("Thread"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    tname = dotted_name(kw.value)
+                    if tname:
+                        targets.add(tname.split(".")[-1])
+    return targets
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    caught = handler.name  # `except ... as e` binds this
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return False
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func) or ""
+            leaf = fname.split(".")[-1]
+            if leaf in _EVENT_FNS:
+                return False
+        # storing the exception object (err.append(e), self._error = e)
+        # hands it to a consumer that re-raises — that's propagation
+        if caught and isinstance(node, ast.Name) and node.id == caught \
+                and isinstance(node.ctx, ast.Load):
+            return False
+    return True
+
+
+def _check_daemon_swallows(sf: SourceFile, findings: List[Finding]) -> None:
+    targets = _thread_targets(sf)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name = node.name
+        if name not in targets and not any(
+            name == h or name.endswith(h) for h in _THREAD_TARGET_NAME_HINTS
+        ):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            etype = sub.type
+            broad = etype is None or dotted_name(etype) in {
+                "Exception", "BaseException"}
+            if isinstance(etype, ast.Tuple):
+                broad = any(dotted_name(e) in {"Exception", "BaseException"}
+                            for e in etype.elts)
+            if broad and _handler_is_silent(sub):
+                findings.append(sf.finding(
+                    sub.lineno, "EH002",
+                    f"thread loop '{name}' swallows exceptions silently — "
+                    "a dead scrape thread looks healthy; log before dropping"))
+
+
+def _check_error_logs(sf: SourceFile, findings: List[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        reraises = any(isinstance(n, ast.Raise) for n in ast.walk(node))
+        if reraises:
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call) or not isinstance(sub.func, ast.Attribute):
+                continue
+            if sub.func.attr != "error":
+                continue
+            recv = dotted_name(sub.func.value) or ""
+            if recv.split(".")[-1] not in _LOGGERISH:
+                continue
+            if not any(kw.arg == "exc_info" for kw in sub.keywords):
+                findings.append(sf.finding(
+                    sub.lineno, "EH003",
+                    "log.error in except handler without exc_info — the "
+                    "traceback dies here"))

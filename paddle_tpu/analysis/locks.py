@@ -1,0 +1,261 @@
+"""Lock-discipline pass (rules LK001-LK003).
+
+* LK001 — an attribute annotated ``# guarded-by: <lock>`` is written
+  outside a ``with <lock>`` block.  ``__init__``/``__new__`` are exempt
+  (no concurrent access before construction returns) and so is any
+  method annotated ``# requires-lock: <lock>`` for the same lock.
+  Mutating method calls on guarded containers (append/pop/update/...)
+  count as writes, as do subscript stores and ``del``.
+
+* LK002 — lock-acquisition-order cycles: if code path A takes lock X
+  then lock Y while path B takes Y then X, the two paths can deadlock.
+  Edges are collected from nested ``with`` blocks and from calls made
+  under a lock into methods that take another lock (one level deep).
+
+* LK003 — a blocking call (sleep, socket send/recv, thread join,
+  ``engine.step``, timeout-bearing queue get/put, writes to an HTTP
+  handler's wfile, event emission to stdout) made while holding a lock.
+  Serving threads contending on a registry lock behind a blocked socket
+  write is exactly the stall class PR 8's review chased by hand.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name, expr_text
+
+_LOCK_TEXT_RE = re.compile(r"(?:^|\.)_?[a-z_]*lock[a-z_]*$", re.IGNORECASE)
+
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "clear", "update", "setdefault",
+    "add", "discard", "sort", "reverse", "rotate",
+}
+
+_BLOCKING_ATTRS = {
+    "sleep", "sendall", "recv", "recv_into", "accept", "connect", "join",
+    "serve_forever", "getresponse", "select", "readline", "sendmsg",
+}
+_BLOCKING_NAMES = {"sleep", "emit_event", "serve_event", "obs_event",
+                   "resilience_event"}
+_SOCKETISH_RECV = ("wfile", "rfile", "sock", "conn", "client", "stream")
+_QUEUEISH_RECV = ("queue", "_q", ".q")
+
+
+def _lock_like(text: str) -> bool:
+    return bool(_LOCK_TEXT_RE.search(text))
+
+
+def _with_locks(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        expr = item.context_expr
+        # `with self._lock:` or `with lock:`; also `cond`/`with self._cv:`
+        text = expr_text(expr)
+        if isinstance(expr, ast.Call):
+            text = expr_text(expr.func)
+        if _lock_like(text) or text.endswith("_cv") or text.endswith("_cond"):
+            out.append(text)
+    return out
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        #: attr -> lock expr text (from guarded-by annotations)
+        self.guards: Dict[str, str] = {}
+        #: method name -> set of lock texts the method body acquires
+        self.method_locks: Dict[str, Set[str]] = {}
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    #: (owner, lock) -> (owner, lock) edges with a witness location
+    edges: Dict[Tuple[str, str], Dict[Tuple[str, str], Tuple[SourceFile, int]]] = {}
+
+    for sf in files:
+        _scan_file(sf, findings, edges)
+
+    _report_cycles(edges, findings)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _scan_file(sf, findings, edges) -> None:
+    for node in ast.iter_child_nodes(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            _scan_class(sf, node, findings, edges)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_function(sf, node, owner=sf.rel, guards=sf.guards.get("", {}),
+                           findings=findings, edges=edges)
+
+
+def _scan_class(sf, cls, findings, edges) -> None:
+    guards = {attr: lock for attr, (lock, _ln) in sf.guards.get(cls.name, {}).items()}
+    methods = [n for n in ast.iter_child_nodes(cls)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    # pre-pass: which locks does each method acquire? (feeds call edges)
+    method_locks: Dict[str, Set[str]] = {}
+    for m in methods:
+        acquired: Set[str] = set()
+        for node in ast.walk(m):
+            if isinstance(node, ast.With):
+                acquired.update(_with_locks(node))
+        if acquired:
+            method_locks[m.name] = acquired
+    for node in methods:
+        _scan_function(sf, node, owner=cls.name, guards=guards,
+                       findings=findings, edges=edges, method_locks=method_locks)
+
+
+def _scan_function(sf, fn, owner, guards, findings, edges, method_locks=None) -> None:
+    method_locks = method_locks or {}
+    exempt_all = fn.name in {"__init__", "__new__", "__del__"}
+    held0: List[str] = []
+    req = sf.requires_lock.get(fn.lineno)
+    if req:
+        held0.append(req)
+
+    def visit(node: ast.AST, held: List[str]) -> None:
+        if isinstance(node, ast.With):
+            locks = _with_locks(node)
+            for lk in locks:
+                for outer in held:
+                    if outer != lk:
+                        edges.setdefault((owner, outer), {}).setdefault(
+                            (owner, lk), (sf, node.lineno))
+            inner = held + locks
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            # nested def: runs later, not under the current lock
+            _scan_function(sf, node, owner, guards, findings, edges)
+            return
+        if held and isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            for lk in method_locks.get(node.func.attr, ()):  # one-level call edge
+                for outer in held:
+                    if outer != lk:
+                        edges.setdefault((owner, outer), {}).setdefault(
+                            (owner, lk), (sf, node.lineno))
+
+        if not exempt_all:
+            _check_guarded_write(sf, node, guards, held, fn, findings)
+        if held:
+            _check_blocking(sf, node, held, findings)
+
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, held0)
+
+
+def _guard_lock_for(target: ast.AST, guards: Dict[str, str]) -> Optional[Tuple[str, str]]:
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name) \
+            and target.value.id == "self" and target.attr in guards:
+        return target.attr, guards[target.attr]
+    if isinstance(target, ast.Subscript):
+        return _guard_lock_for(target.value, guards)
+    return None
+
+
+def _lock_held(lock: str, held: Sequence[str]) -> bool:
+    return any(h == lock or h.endswith("." + lock) or lock.endswith("." + h)
+               for h in held)
+
+
+def _check_guarded_write(sf, node, guards, held, fn, findings) -> None:
+    if not guards:
+        return
+    targets: List[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATOR_METHODS:
+        targets = [node.func.value]
+    for tgt in targets:
+        hit = _guard_lock_for(tgt, guards)
+        if hit is None:
+            continue
+        attr, lock = hit
+        if not _lock_held(lock, held):
+            findings.append(sf.finding(
+                node.lineno, "LK001",
+                f"write to 'self.{attr}' (guarded-by {lock}) outside the lock "
+                f"in '{fn.name}'"))
+
+
+def _check_blocking(sf, node, held, findings) -> None:
+    if not isinstance(node, ast.Call):
+        return
+    name = dotted_name(node.func)
+    label: Optional[str] = None
+    if name in _BLOCKING_NAMES or name == "time.sleep":
+        label = f"{name}(...)"
+    elif isinstance(node.func, ast.Attribute):
+        attr = node.func.attr
+        recv = expr_text(node.func.value)
+        recv_l = recv.lower()
+        if _lock_like(recv_l):
+            return  # lock.acquire / cv.wait on the held lock's cv is its own story
+        if attr in _BLOCKING_ATTRS:
+            label = f"{recv}.{attr}(...)"
+        elif attr == "step" and "engine" in recv_l:
+            label = f"{recv}.step(...)"
+        elif attr == "wait" and ("event" in recv_l or "_stop" in recv_l
+                                 or "_ev" in recv_l):
+            label = f"{recv}.wait(...)"
+        elif attr in {"get", "put"}:
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+            queueish = any(h in recv_l for h in _QUEUEISH_RECV)
+            if queueish and (has_timeout or attr == "get" and not node.args):
+                label = f"{recv}.{attr}(...)"
+        elif attr in {"write", "flush", "send", "read", "makefile"} and any(
+            h in recv_l for h in _SOCKETISH_RECV
+        ):
+            label = f"{recv}.{attr}(...)"
+        elif attr in {"request", "urlopen"} and ("conn" in recv_l or "http" in recv_l):
+            label = f"{recv}.{attr}(...)"
+    if label is not None:
+        findings.append(sf.finding(
+            node.lineno, "LK003",
+            f"blocking call {label} while holding {', '.join(held)}"))
+
+
+def _report_cycles(edges, findings) -> None:
+    """DFS for cycles in the (owner, lock) acquisition-order graph."""
+    graph: Dict[Tuple[str, str], Dict[Tuple[str, str], Tuple[SourceFile, int]]] = edges
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: Dict[Tuple[str, str], int] = {}
+    reported: Set[frozenset] = set()
+
+    def dfs(node, path):
+        color[node] = GREY
+        for nxt, (sf, lineno) in sorted(
+            graph.get(node, {}).items(), key=lambda kv: (kv[0][0], kv[0][1])
+        ):
+            if color.get(nxt, WHITE) == GREY:
+                cycle = path[path.index(nxt):] + [nxt] if nxt in path else [node, nxt]
+                key = frozenset(cycle[:-1] if cycle and cycle[0] == cycle[-1] else cycle)
+                if key not in reported:
+                    reported.add(key)
+                    desc = " -> ".join(f"{o}:{l}" for o, l in cycle)
+                    findings.append(sf.finding(
+                        lineno, "LK002",
+                        f"lock-acquisition-order cycle: {desc}"))
+            elif color.get(nxt, WHITE) == WHITE:
+                dfs(nxt, path + [nxt])
+        color[node] = BLACK
+
+    for start in sorted(graph):
+        if color.get(start, WHITE) == WHITE:
+            dfs(start, [start])

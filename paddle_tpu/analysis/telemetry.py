@@ -1,0 +1,241 @@
+"""Telemetry-schema pass (rules TS001-TS005).
+
+OBSERVABILITY.md's "Metric inventory" table is the contract between the
+code and every dashboard/alert built on the scrape; this pass keeps the
+two sides honest in both directions:
+
+* TS001 — a ``registry.counter/gauge/histogram("ptpu_...")`` call whose
+  series name is missing from the inventory table.
+* TS002 — an inventory row whose series is never registered anywhere in
+  the analyzed code (only reported when the analyzed set registers at
+  least one ``ptpu_`` series, so running the tool on a fixture dir
+  doesn't declare the whole catalog stale).
+* TS003 — name matches but the kind (counter vs gauge vs histogram) or
+  the label set disagrees with the table row.
+* TS004 — a dynamic value (f-string, str()/format()/concat) passed to
+  ``.labels()``: label values become unbounded series cardinality.
+  Plain variables are allowed — bounded enums arrive via variables —
+  but *constructed* strings are always request-derived.
+* TS005 — an ``emit_event``-family call whose stream literal is not one
+  of the documented streams (serve / resilience / obs).
+
+The doc parser understands the inventory's two compaction idioms:
+```a` / `b``` rows (shared type/labels) and brace expansion
+(```ptpu_resilience_{preempts,hangs}_total```).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import KNOWN_EVENT_STREAMS, Finding, SourceFile, dotted_name, expr_text
+
+_REG_METHODS = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
+_CODE_SPAN_RE = re.compile(r"`([^`]+)`")
+_BRACE_RE = re.compile(r"\{([^{}]*)\}")
+_SERIES_NAME_RE = re.compile(r"^ptpu_[a-z0-9_]+$")
+_EVENT_FNS = {"emit_event"}
+#: wrappers in utils/log.py that pin the stream themselves
+_EVENT_WRAPPERS = {"serve_event": "serve", "resilience_event": "resilience",
+                   "obs_event": "obs"}
+
+
+class DocSeries:
+    def __init__(self, name: str, kind: str, labels: Tuple[str, ...], line: int):
+        self.name = name
+        self.kind = kind
+        self.labels = labels
+        self.line = line
+
+
+def _expand_braces(text: str) -> List[str]:
+    m = _BRACE_RE.search(text)
+    if not m:
+        return [text]
+    head, tail = text[: m.start()], text[m.end():]
+    out: List[str] = []
+    for part in m.group(1).split(","):
+        out.extend(_expand_braces(head + part.strip() + tail))
+    return out
+
+
+def parse_inventory(doc_path: str,
+                    root: str = "") -> Tuple[Dict[str, DocSeries], str]:
+    """Parse the Metric inventory table -> {series name: DocSeries}."""
+    series: Dict[str, DocSeries] = {}
+    if root:
+        rel = os.path.relpath(doc_path, root).replace(os.sep, "/")
+    else:
+        rel = os.path.basename(doc_path)
+    try:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return series, rel
+    in_inventory = False
+    for lineno, line in enumerate(lines, start=1):
+        if line.startswith("## "):
+            in_inventory = line.lower().startswith("## metric inventory")
+            continue
+        if not in_inventory or not line.startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        # markdown escapes the enum separator as \|; that split is fine
+        # because series/label cells never contain raw pipes.
+        cells = [c.replace("\\", "") for c in cells]
+        if len(cells) < 3 or set(cells[0]) <= {"-", " ", ":"} or cells[0] == "series":
+            continue
+        names: List[str] = []
+        for span in _CODE_SPAN_RE.findall(cells[0]):
+            for name in _expand_braces(span):
+                if _SERIES_NAME_RE.match(name):
+                    names.append(name)
+        if not names:
+            continue
+        kind = cells[1].strip().lower()
+        labels = tuple(
+            lab for lab in (
+                span.split("=")[0] for span in _CODE_SPAN_RE.findall(cells[2])
+            ) if re.match(r"^[a-z_][a-z0-9_]*$", lab)
+        )
+        for name in names:
+            series[name] = DocSeries(name, kind, labels, lineno)
+    return series, rel
+
+
+def _registration_labels(call: ast.Call) -> Optional[Tuple[str, ...]]:
+    """Extract the labelnames tuple from a registration call, if static."""
+    node: Optional[ast.AST] = None
+    if len(call.args) >= 3:
+        node = call.args[2]
+    for kw in call.keywords:
+        if kw.arg == "labelnames":
+            node = kw.value
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        labels = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                labels.append(elt.value)
+            else:
+                return None  # dynamic labelnames: can't check statically
+        return tuple(labels)
+    return None
+
+
+def _dynamic_label_value(node: ast.AST) -> bool:
+    if isinstance(node, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in node.values)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in {"str", "repr", "hex", "format"}:
+            return True
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "format":
+            return True
+    return False
+
+
+def run(files: Sequence[SourceFile], doc_path: str,
+        root: str = "") -> List[Finding]:
+    findings: List[Finding] = []
+    doc: Dict[str, DocSeries] = {}
+    doc_rel = ""
+    if doc_path:
+        doc, doc_rel = parse_inventory(doc_path, root)
+
+    registered: Set[str] = set()
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _check_registration(sf, node, doc, doc_path, registered, findings)
+            _check_labels_call(sf, node, findings)
+            _check_event_stream(sf, node, findings)
+
+    # TS002: doc rows nothing registers — only meaningful on a run that
+    # actually covers the instrumented packages.
+    if doc and registered:
+        for name in sorted(doc):
+            if name not in registered:
+                row = doc[name]
+                findings.append(Finding(
+                    doc_rel, row.line, "TS002",
+                    f"documented series '{name}' is never registered in the "
+                    "analyzed code", snippet=f"| `{name}` |"))
+    return findings
+
+
+def _check_registration(sf, call, doc, doc_path, registered, findings) -> None:
+    if not isinstance(call.func, ast.Attribute):
+        return
+    kind = _REG_METHODS.get(call.func.attr)
+    if kind is None or not call.args:
+        return
+    first = call.args[0]
+    if not (isinstance(first, ast.Constant) and isinstance(first.value, str)):
+        return
+    name = first.value
+    if not name.startswith("ptpu_"):
+        return
+    registered.add(name)
+    if not doc_path:
+        return
+    row = doc.get(name)
+    if row is None:
+        findings.append(sf.finding(
+            call.lineno, "TS001",
+            f"series '{name}' is not documented in OBSERVABILITY.md's "
+            "metric inventory"))
+        return
+    if row.kind != kind:
+        findings.append(sf.finding(
+            call.lineno, "TS003",
+            f"'{name}' registered as {kind} but documented as {row.kind}"))
+    labels = _registration_labels(call)
+    if labels is not None and tuple(labels) != row.labels:
+        findings.append(sf.finding(
+            call.lineno, "TS003",
+            f"'{name}' label set {tuple(labels)!r} disagrees with the "
+            f"documented {row.labels!r}"))
+
+
+def _check_labels_call(sf, call, findings) -> None:
+    if not (isinstance(call.func, ast.Attribute) and call.func.attr == "labels"):
+        return
+    recv = expr_text(call.func.value)
+    if not any(h in recv for h in ("_m_", "_g_", "_c_", "_h_", "metric",
+                                   "counter", "gauge", "histogram")):
+        return
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if _dynamic_label_value(arg):
+            findings.append(sf.finding(
+                call.lineno, "TS004",
+                f"dynamic label value '{expr_text(arg)}' on '{recv}.labels' — "
+                "unbounded series cardinality"))
+
+
+def _check_event_stream(sf, call, findings) -> None:
+    fname = dotted_name(call.func)
+    if fname in _EVENT_WRAPPERS:
+        return  # wrapper pins a documented stream
+    if fname not in _EVENT_FNS or not call.args:
+        return
+    if "utils/log.py" in sf.rel:
+        return  # the emitter itself takes the stream as a parameter
+    first = call.args[0]
+    if isinstance(first, ast.Constant) and isinstance(first.value, str):
+        if first.value not in KNOWN_EVENT_STREAMS:
+            findings.append(sf.finding(
+                call.lineno, "TS005",
+                f"emit_event stream '{first.value}' is not documented "
+                f"(known: {', '.join(sorted(KNOWN_EVENT_STREAMS))})"))
+    else:
+        findings.append(sf.finding(
+            call.lineno, "TS005",
+            f"emit_event stream '{expr_text(first)}' is not a string literal — "
+            "streams must be statically checkable"))

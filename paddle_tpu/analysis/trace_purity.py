@@ -1,0 +1,365 @@
+"""Trace-purity / recompile-hazard pass (rules TP001-TP004).
+
+jit-traced Python runs ONCE per cache entry; anything host-visible inside
+it (clocks, RNG, prints, metric bumps) silently executes at trace time
+and never again, and anything that materializes a traced array forces a
+device sync or an abstract-value error.  Python-level branches on traced
+values bake one branch into the compiled program.  The engine's whole
+design rides on the exactly-1-compile invariant (ptpu_engine_compiles
+pinned at 1 since PR 6), so constructing jits per call is flagged too.
+
+Roots: ``@jax.jit`` decorators (including ``functools.partial(jax.jit,
+...)``), ``jax.jit(fn)`` call sites, ``pl.pallas_call`` kernels, and the
+function-valued arguments of ``jax.lax`` control-flow combinators /
+``vmap``/``grad``-family transforms.  From each root we walk same-file
+callees by name (cross-file by method name when the name is rare enough
+to resolve unambiguously), to a bounded depth.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, SourceFile, dotted_name, expr_text
+
+MAX_DEPTH = 8
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PALLAS_SUFFIX = "pallas_call"
+#: transform -> indices of function-valued positional args (None = all)
+_FN_ARG_TRANSFORMS = {
+    "jax.lax.scan": (0,),
+    "lax.scan": (0,),
+    "jax.lax.fori_loop": (2,),
+    "lax.fori_loop": (2,),
+    "jax.lax.while_loop": (0, 1),
+    "lax.while_loop": (0, 1),
+    "jax.lax.cond": (1, 2),
+    "lax.cond": (1, 2),
+    "jax.lax.switch": None,
+    "lax.switch": None,
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+    "jax.custom_vjp": (0,),
+    "jax.custom_jvp": (0,),
+}
+
+#: dotted-prefix host effects (call makes the trace impure)
+_HOST_EFFECT_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "secrets.", "uuid.",
+    "os.environ", "os.getenv", "os.urandom", "logging.",
+)
+_HOST_EFFECT_NAMES = {"print", "input", "open", "breakpoint", "emit_event",
+                      "serve_event", "obs_event", "resilience_event"}
+#: materializers (TP002)
+_MATERIALIZE_DOTTED = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                       "np.copy", "numpy.copy"}
+#: metric-object method names (TP001 when the receiver looks metric-ish)
+_METRIC_METHODS = {"inc", "observe", "labels", "set"}
+_METRIC_RECV_HINTS = ("_m_", "_g_", "_c_", "_h_", "metric", "counter", "gauge",
+                      "histogram", "registry")
+#: receiver bases that are array/stdlib modules, never user functions
+_SKIP_CALL_BASES = {"jnp", "np", "numpy", "jax", "lax", "pl", "pltpu", "math",
+                    "functools", "os", "sys", "re", "json", "ast", "logging",
+                    "itertools", "collections", "dataclasses", "typing"}
+#: one-time-construction contexts where building a jit is legitimate
+_CONSTRUCTION_NAME_HINTS = ("init", "build", "make", "setup", "warmup",
+                            "export", "save", "compile", "lower", "main",
+                            "cli", "bench", "debug", "trace")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ...)``."""
+    name = dotted_name(node)
+    if name in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname in _JIT_NAMES:
+            return True
+        if fname in {"partial", "functools.partial"} and node.args:
+            return dotted_name(node.args[0]) in _JIT_NAMES
+    return False
+
+
+def _jit_call_is_static(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        return any(kw.arg and kw.arg.startswith("static_") for kw in node.keywords)
+    return False
+
+
+class _DefIndex:
+    """name -> [(SourceFile, def node)] across all analyzed files."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.by_name: Dict[str, List[Tuple[SourceFile, ast.AST]]] = {}
+        self.by_file: Dict[str, Dict[str, List[ast.AST]]] = {}
+        for sf in files:
+            local: Dict[str, List[ast.AST]] = {}
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.by_name.setdefault(node.name, []).append((sf, node))
+                    local.setdefault(node.name, []).append(node)
+                elif isinstance(node, ast.Lambda):
+                    pass
+            self.by_file[sf.rel] = local
+
+    def resolve(
+        self, caller: SourceFile, name: str, cross_file: bool = True
+    ) -> List[Tuple[SourceFile, ast.AST]]:
+        if name.startswith("__") and name.endswith("__"):
+            return []
+        local = self.by_file.get(caller.rel, {}).get(name)
+        if local:
+            return [(caller, node) for node in local]
+        if not cross_file:
+            return []
+        hits = self.by_name.get(name, [])
+        # cross-file resolution only when the name is unambiguous enough
+        return hits if 0 < len(hits) <= 3 else []
+
+
+def _collect_roots(
+    files: Sequence[SourceFile], index: _DefIndex
+) -> List[Tuple[SourceFile, ast.AST, bool, bool]]:
+    """Returns (file, fn node, is_direct_root, has_static_args) tuples."""
+    roots: List[Tuple[SourceFile, ast.AST, bool, bool]] = []
+    seen: Set[int] = set()
+
+    def add(sf: SourceFile, fn: ast.AST, static: bool) -> None:
+        if id(fn) not in seen:
+            seen.add(id(fn))
+            roots.append((sf, fn, True, static))
+
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if _is_jit_expr(deco):
+                        add(sf, node, _jit_call_is_static(deco))
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func)
+                fn_args: List[ast.AST] = []
+                static = False
+                if fname in _JIT_NAMES:
+                    fn_args = node.args[:1]
+                    static = _jit_call_is_static(node)
+                elif fname and fname.endswith(_PALLAS_SUFFIX):
+                    fn_args = node.args[:1]
+                    static = True  # pallas index maps are static by design
+                elif fname in _FN_ARG_TRANSFORMS:
+                    spec = _FN_ARG_TRANSFORMS[fname]
+                    if spec is None:
+                        fn_args = list(node.args)
+                    else:
+                        fn_args = [node.args[i] for i in spec if i < len(node.args)]
+                    static = True  # combinator bodies get traced; branches there
+                    # are usually shape-static dispatch, so keep TP003 quiet.
+                for arg in fn_args:
+                    if isinstance(arg, ast.Lambda):
+                        add(sf, arg, static)
+                    elif isinstance(arg, ast.Name):
+                        for tsf, tnode in index.resolve(sf, arg.id):
+                            add(tsf, tnode, static)
+    return roots
+
+
+def _callee_names(fn: ast.AST) -> List[Tuple[str, bool]]:
+    """(name, is_method_call) for every call inside ``fn`` worth following."""
+    out: List[Tuple[str, bool]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Name):
+            out.append((func.id, False))
+        elif isinstance(func, ast.Attribute):
+            base = func.value
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name) and base.id in _SKIP_CALL_BASES:
+                continue
+            if isinstance(base, ast.Call):  # e.g. jnp.zeros(...).sum()
+                continue
+            out.append((func.attr, True))
+    return out
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n not in {"self", "cls"}}
+
+
+def _fn_label(fn: ast.AST) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+def _enclosing_is_construction(stack: Sequence[ast.AST]) -> bool:
+    for node in reversed(stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            name = node.name.lower()
+            if name == "__init__" or any(h in name for h in _CONSTRUCTION_NAME_HINTS):
+                return True
+            return False
+    return True  # module level: one-time by definition
+
+
+def _check_traced_body(
+    sf: SourceFile,
+    fn: ast.AST,
+    direct: bool,
+    static: bool,
+    findings: List[Finding],
+    flagged: Set[Tuple[str, int, str]],
+) -> None:
+    """Flag TP001/TP002 (always) and TP003 (direct, non-static roots only)."""
+    params = _param_names(fn)
+    label = _fn_label(fn)
+
+    def emit(lineno: int, rule: str, message: str) -> None:
+        key = (sf.rel, lineno, rule)
+        if key not in flagged:
+            flagged.add(key)
+            findings.append(sf.finding(lineno, rule, message))
+
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    if name in _HOST_EFFECT_NAMES or any(
+                        name.startswith(p) for p in _HOST_EFFECT_PREFIXES
+                    ):
+                        emit(node.lineno, "TP001",
+                             f"host effect '{name}(...)' inside traced '{label}' "
+                             "runs at trace time only")
+                        continue
+                    if name in _MATERIALIZE_DOTTED:
+                        emit(node.lineno, "TP002",
+                             f"'{name}' materializes a traced value inside '{label}'")
+                        continue
+                    if name.startswith("log.") or name.startswith("logger."):
+                        emit(node.lineno, "TP001",
+                             f"log call '{name}(...)' inside traced '{label}'")
+                        continue
+                if isinstance(node.func, ast.Attribute):
+                    attr = node.func.attr
+                    recv = expr_text(node.func.value)
+                    if attr == "item" and not node.args:
+                        emit(node.lineno, "TP002",
+                             f"'.item()' on '{recv}' forces a device sync inside "
+                             f"traced '{label}'")
+                    elif attr in _METRIC_METHODS and any(
+                        h in recv for h in _METRIC_RECV_HINTS
+                    ):
+                        emit(node.lineno, "TP001",
+                             f"metric call '{recv}.{attr}(...)' inside traced "
+                             f"'{label}' only fires at trace time")
+                if isinstance(node.func, ast.Name) and node.func.id in {"float", "int", "bool"} \
+                        and len(node.args) == 1:
+                    arg_names = {n.id for n in ast.walk(node.args[0])
+                                 if isinstance(n, ast.Name)}
+                    hit = arg_names & params
+                    if hit:
+                        emit(node.lineno, "TP002",
+                             f"'{node.func.id}({expr_text(node.args[0])})' "
+                             f"materializes traced argument "
+                             f"'{sorted(hit)[0]}' inside '{label}'")
+            elif isinstance(node, (ast.If, ast.While)) and direct and not static:
+                _check_branch(sf, node, params, label, emit)
+
+
+def _check_branch(sf, node, params, label, emit) -> None:
+    """TP003: the branch condition mentions a traced parameter."""
+    exempt: Set[int] = set()
+    for sub in ast.walk(node.test):
+        # `x is None` / `x is not None` guards are trace-static dispatch
+        if isinstance(sub, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops
+        ):
+            for n in ast.walk(sub):
+                exempt.add(id(n))
+        # isinstance() checks are python-type dispatch, static per trace
+        if isinstance(sub, ast.Call) and dotted_name(sub.func) in {
+            "isinstance", "len", "getattr", "hasattr", "callable"
+        }:
+            for n in ast.walk(sub):
+                exempt.add(id(n))
+    for sub in ast.walk(node.test):
+        if id(sub) in exempt:
+            continue
+        if isinstance(sub, ast.Name) and sub.id in params:
+            emit(node.lineno, "TP003",
+                 f"Python branch on traced value '{sub.id}' in '{label}' is "
+                 "resolved once at trace time (use jnp.where / lax.cond)")
+            return
+
+
+def _check_per_call_jit(files: Sequence[SourceFile], findings: List[Finding]) -> None:
+    """TP004: jit constructed inside loops or immediately invoked per call."""
+    for sf in files:
+        stack: List[ast.AST] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Call):
+                inner = node.func
+                if isinstance(inner, ast.Call) and _is_jit_expr(inner.func) \
+                        and not _enclosing_is_construction(stack):
+                    findings.append(sf.finding(
+                        node.lineno, "TP004",
+                        "jax.jit(...)(...) constructed and invoked in one "
+                        "expression — new cache entry risk on every call"))
+                if _is_jit_expr(node.func) and any(
+                    isinstance(s, (ast.For, ast.While)) for s in stack
+                ) and not _enclosing_is_construction(stack):
+                    findings.append(sf.finding(
+                        node.lineno, "TP004",
+                        "jax.jit constructed inside a loop — hoist it so the "
+                        "compile cache stays at one entry"))
+            stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            stack.pop()
+
+        visit(sf.tree)
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    flagged: Set[Tuple[str, int, str]] = set()
+    index = _DefIndex(files)
+    roots = _collect_roots(files, index)
+
+    # BFS from roots through same-file / unambiguous callees.
+    visited: Set[int] = set()
+    queue: List[Tuple[SourceFile, ast.AST, bool, bool, int]] = [
+        (sf, fn, True, static, 0) for sf, fn, _, static in roots
+    ]
+    while queue:
+        sf, fn, direct, static, depth = queue.pop(0)
+        if id(fn) in visited:
+            continue
+        visited.add(id(fn))
+        _check_traced_body(sf, fn, direct, static, findings, flagged)
+        if depth >= MAX_DEPTH:
+            continue
+        for name, is_method in _callee_names(fn):
+            # Method calls resolve same-file only: common method names
+            # (`step`, `sample`, `update`) otherwise leak trace-ness into
+            # host-side classes that merely share a vocabulary.
+            for tsf, tnode in index.resolve(sf, name, cross_file=not is_method):
+                if id(tnode) not in visited:
+                    queue.append((tsf, tnode, False, True, depth + 1))
+
+    _check_per_call_jit(files, findings)
+    return findings

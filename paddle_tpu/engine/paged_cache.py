@@ -453,10 +453,15 @@ class PagedKVCache:
         gone and the free list full. Index entries may remain, but only
         for cached-free blocks (their content stays reusable by
         design); an indexed block NOT on the free list is a leak."""
-        assert not self._tables, f"live sequences: {list(self._tables)}"
-        assert not self._refs, f"leaked refcounts: {self._refs}"
-        assert len(self._free) == self.num_blocks - 1, (
-            f"free list {len(self._free)} != {self.num_blocks - 1}")
+        if self._tables:
+            raise RuntimeError(f"live sequences: {list(self._tables)}")
+        if self._refs:
+            raise RuntimeError(f"leaked refcounts: {self._refs}")
+        if len(self._free) != self.num_blocks - 1:
+            raise RuntimeError(
+                f"free list {len(self._free)} != {self.num_blocks - 1}")
         free = set(self._free)
         leaked = [b for b in self._key_of if b not in free]
-        assert not leaked, f"indexed blocks not on the free list: {leaked}"
+        if leaked:
+            raise RuntimeError(
+                f"indexed blocks not on the free list: {leaked}")

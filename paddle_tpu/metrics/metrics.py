@@ -319,7 +319,8 @@ class DetectionMAP(MetricBase):
                  ap_version: str = "integral",
                  evaluate_difficult: bool = False, name=None):
         super().__init__(name)
-        assert ap_version in ("integral", "11point")
+        if ap_version not in ("integral", "11point"):
+            raise ValueError(f"unknown ap_version: {ap_version!r}")
         self.overlap_threshold = overlap_threshold
         self.ap_version = ap_version
         self.evaluate_difficult = evaluate_difficult

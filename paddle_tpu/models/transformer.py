@@ -89,20 +89,24 @@ class MultiHeadAttention(Module):
         fused_qkv (the packed [q|k|v] head-major layout assumes equal
         head counts)."""
         super().__init__()
-        assert model_dim % num_heads == 0
+        if model_dim % num_heads != 0:
+            raise ValueError(
+                f"model_dim {model_dim} not divisible by num_heads {num_heads}")
         self.model_dim = model_dim
         self.num_heads = num_heads
         self.head_dim = model_dim // num_heads
         self.num_kv_heads = num_kv_heads or num_heads
-        assert num_heads % self.num_kv_heads == 0, (
-            f"num_heads {num_heads} not a multiple of num_kv_heads "
-            f"{self.num_kv_heads}")
+        if num_heads % self.num_kv_heads != 0:
+            raise ValueError(
+                f"num_heads {num_heads} not a multiple of num_kv_heads "
+                f"{self.num_kv_heads}")
         self.fused_qkv = fused_qkv
         kv_dim = self.num_kv_heads * self.head_dim
-        if fused_qkv:
-            assert self.num_kv_heads == num_heads, (
+        if fused_qkv and self.num_kv_heads != num_heads:
+            raise ValueError(
                 "fused_qkv packs equal-width q/k/v; use unfused "
                 "projections with num_kv_heads")
+        if fused_qkv:
             self.qkv = Linear(3 * model_dim, dtype=dtype)
             self.q_proj = Linear(model_dim, dtype=dtype)   # cross-attn q
             self.kv = Linear(2 * model_dim, dtype=dtype)   # cross-attn kv

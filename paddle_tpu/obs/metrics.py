@@ -85,7 +85,7 @@ class _CounterChild:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0               # guarded-by: self._lock
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -108,7 +108,7 @@ class _GaugeChild:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0               # guarded-by: self._lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -139,12 +139,12 @@ class _HistogramChild:
 
     def __init__(self, bounds: Tuple[float, ...]):
         self._lock = threading.Lock()
-        self._bounds = bounds
-        self._counts = [0] * (len(bounds) + 1)   # [+1] = overflow (+Inf)
-        self._sum = 0.0
-        self._count = 0
-        self._min = math.inf
-        self._max = -math.inf
+        self._bounds = bounds           # immutable after construction
+        self._counts = [0] * (len(bounds) + 1)   # guarded-by: self._lock
+        self._sum = 0.0                 # guarded-by: self._lock
+        self._count = 0                 # guarded-by: self._lock
+        self._min = math.inf            # guarded-by: self._lock
+        self._max = -math.inf           # guarded-by: self._lock
 
     def observe(self, value: float) -> None:
         v = float(value)
@@ -249,7 +249,7 @@ class _Family:
         self.help = help
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._children: Dict[Tuple[str, ...], object] = {}
+        self._children: Dict[Tuple[str, ...], object] = {}  # guarded-by: self._lock
         if not self.labelnames:
             self._children[()] = self._new_child()
 
@@ -404,7 +404,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Family] = {}
+        self._metrics: Dict[str, _Family] = {}  # guarded-by: self._lock
 
     def _get_or_create(self, cls, name, help, labelnames, **kw) -> _Family:
         with self._lock:

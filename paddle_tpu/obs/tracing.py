@@ -39,9 +39,9 @@ class RequestTracer:
     def __init__(self, keep_last: int = 2048, enabled: bool = True):
         self.enabled = enabled
         self._lock = threading.Lock()
-        self._events: Dict[int, List[dict]] = {}     # req_id -> events
-        self._open: Dict[int, dict] = {}             # req_id -> open span
-        self._done: Deque[Tuple[int, List[dict]]] = deque(maxlen=keep_last)
+        self._events: Dict[int, List[dict]] = {}     # guarded-by: self._lock
+        self._open: Dict[int, dict] = {}             # guarded-by: self._lock
+        self._done: Deque[Tuple[int, List[dict]]] = deque(maxlen=keep_last)  # guarded-by: self._lock
 
     # -- lifecycle hooks (engine-facing) ----------------------------------
     def on_enqueue(self, req_id: int) -> None:
@@ -87,17 +87,20 @@ class RequestTracer:
                 self._done.append((req_id, evs))
 
     # -- internals (lock held) --------------------------------------------
+    # requires-lock: self._lock
     def _open_span(self, req_id: int, name: str) -> None:
         self._close_span(req_id)
         ev = {"name": name, "ph": "X", "ts": now_us(), "dur": None}
         self._open[req_id] = ev
         self._events.setdefault(req_id, []).append(ev)
 
+    # requires-lock: self._lock
     def _close_span(self, req_id: int) -> None:
         ev = self._open.pop(req_id, None)
         if ev is not None:
             ev["dur"] = now_us() - ev["ts"]
 
+    # requires-lock: self._lock
     def _mark(self, req_id: int, name: str, **args) -> None:
         self._events.setdefault(req_id, []).append(
             {"name": name, "ph": "i", "ts": now_us(), "args": args})

@@ -171,7 +171,8 @@ def sequence_reshape(x, lengths, new_dim: int):
     Padded form: [B, T, D] -> [B, T*D//new_dim, new_dim] + new lengths.
     Requires (T*D) % new_dim == 0 for the padded buffer."""
     b, t, d = x.shape
-    assert (t * d) % new_dim == 0, "padded payload must divide new_dim"
+    if (t * d) % new_dim != 0:
+        raise ValueError("padded payload must divide new_dim")
     new_t = t * d // new_dim
     out = x.reshape(b, new_t, new_dim)
     new_lengths = (lengths * d) // new_dim

@@ -119,9 +119,9 @@ class ServeFrontend:
         self._stopped = threading.Event()    # engine loop exited
         self._submit: "deque[_Stream]" = deque()
         self._cancel: "deque[_Stream]" = deque()
-        self._active: Dict[int, _Stream] = {}    # req_id -> stream
         self._lock = threading.Lock()
-        self._open_streams = 0               # HTTP handlers mid-write
+        self._active: Dict[int, _Stream] = {}    # guarded-by: self._lock
+        self._open_streams = 0               # guarded-by: self._lock
         self._draining = False
         self._drain_started = 0.0
         self._stop_requested = False
@@ -401,9 +401,13 @@ class ServeFrontend:
         if deadline_hit:
             self._abort_active("drain_deadline", count_drain=True)
         with self._lock:
+            # read both under the lock: a handler that already popped its
+            # stream from _active but hasn't finished its final write yet
+            # is only visible through _open_streams.
             engine_idle = not self._active
+            streams_open = self._open_streams > 0
         return (engine_idle and not self.engine.scheduler.has_work()
-                and (self._open_streams == 0 or deadline_hit))
+                and (not streams_open or deadline_hit))
 
     def _abort_active(self, reason: str, count_drain: bool = False) -> None:
         with self._lock:

@@ -129,13 +129,22 @@ class Router:
         self._serve_thread: Optional[threading.Thread] = None
         self._scrape_thread: Optional[threading.Thread] = None
         self._stop_scrape = threading.Event()
+        # One lock covers the router's mutable shared state: the in-flight
+        # count AND every ReplicaState field the scrape loop and handler
+        # threads both touch. Network I/O never happens under it.
         self._lock = threading.Lock()
-        self._inflight = 0
-        self._draining = False
+        self._inflight = 0          # guarded-by: self._lock
+        self._draining = False      # guarded-by: self._lock
         self._drained = threading.Event()
 
     # -- scrape loop ------------------------------------------------------
     def _scrape_once(self, r: ReplicaState) -> None:
+        # HTTP happens into locals; ReplicaState fields are published in
+        # one locked write so handler threads (plan_route, _proxy's
+        # connect-failure demotion) never see a half-updated replica.
+        ready = False
+        reason = ""
+        vals = {}
         try:
             conn = HTTPConnection(r.host, r.port,
                                   timeout=self.connect_timeout_s)
@@ -143,24 +152,28 @@ class Router:
                 conn.request("GET", "/readyz")
                 resp = conn.getresponse()
                 body = resp.read().decode("utf-8", "replace").strip()
-                r.ready = resp.status == 200
-                r.reason = "" if r.ready else body
+                ready = resp.status == 200
+                reason = "" if ready else body
                 conn.request("GET", "/metrics")
                 resp = conn.getresponse()
                 text = resp.read().decode("utf-8", "replace")
             finally:
                 conn.close()
             vals = parse_prometheus_values(text)
-            r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
-            r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
-            r.last_scrape = time.monotonic()
         except OSError as e:
-            r.ready = False
-            r.reason = f"scrape failed: {e}"
-        self._m_replica_ready.labels(replica=r.url).set(
-            1.0 if r.ready else 0.0)
-        self._m_replica_hit.labels(replica=r.url).set(r.hit_rate)
-        self._m_replica_depth.labels(replica=r.url).set(r.queue_depth)
+            ready = False
+            reason = f"scrape failed: {e}"
+        with self._lock:
+            r.ready = ready
+            r.reason = reason
+            if vals:
+                r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
+                r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
+                r.last_scrape = time.monotonic()
+            hit_rate, queue_depth = r.hit_rate, r.queue_depth
+        self._m_replica_ready.labels(replica=r.url).set(1.0 if ready else 0.0)
+        self._m_replica_hit.labels(replica=r.url).set(hit_rate)
+        self._m_replica_depth.labels(replica=r.url).set(queue_depth)
 
     def scrape_now(self) -> None:
         """One synchronous pass over every replica (startup, tests)."""
@@ -180,10 +193,13 @@ class Router:
         then shortest queue."""
         primary = self.replicas[prefix_shard(prompt, len(self.replicas),
                                              self.prefix_len)]
+        with self._lock:    # one consistent snapshot to rank against
+            stats = {r: (r.ready, r.hit_rate, r.queue_depth)
+                     for r in self.replicas}
         fallbacks = sorted(
-            (r for r in self.replicas if r is not primary and r.ready),
-            key=lambda r: (-r.hit_rate, r.queue_depth))
-        if primary.ready:
+            (r for r in self.replicas if r is not primary and stats[r][0]),
+            key=lambda r: (-stats[r][1], stats[r][2]))
+        if stats[primary][0]:
             return [primary] + fallbacks
         return fallbacks + [primary]    # last-ditch: maybe stale scrape
 
@@ -268,10 +284,11 @@ class Router:
 
     # -- HTTP -------------------------------------------------------------
     def readiness(self) -> Tuple[bool, str]:
-        if self._draining:
-            return False, "draining"
-        if any(r.ready for r in self.replicas):
-            return True, ""
+        with self._lock:
+            if self._draining:
+                return False, "draining"
+            if any(r.ready for r in self.replicas):
+                return True, ""
         return False, "no ready replicas"
 
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
@@ -319,15 +336,21 @@ class Router:
         if not candidates:
             self._shed(h, "no_replica")
             return
-        with self._lock:
-            self._inflight += 1
-        self._m_inflight.set(self._inflight)
+        self._track_inflight(+1)
         try:
             self._proxy(h, raw, prompt, candidates)
         finally:
-            with self._lock:
-                self._inflight -= 1
-            self._m_inflight.set(self._inflight)
+            self._track_inflight(-1)
+
+    def _track_inflight(self, delta: int) -> None:
+        """Count and gauge move together under the lock: the old code
+        re-read `self._inflight` outside it, so two crossing requests
+        could publish stale values out of order and leave the gauge
+        permanently off. The gauge's own child lock is leaf-level (it
+        never takes router locks), so nesting it here cannot deadlock."""
+        with self._lock:
+            self._inflight += delta
+            self._m_inflight.set(float(self._inflight))
 
     def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
                prompt: Sequence[int],
@@ -347,8 +370,9 @@ class Router:
                     headers={"Content-Type": "application/json"})
                 resp = conn.getresponse()
             except OSError:
-                r.ready = False
-                r.reason = "connect failed"
+                with self._lock:
+                    r.ready = False
+                    r.reason = "connect failed"
                 continue
             if resp.status == 503:      # replica shed: try the next
                 last_resp = (503, resp.read())

@@ -1,0 +1,34 @@
+"""Known-bad error-hygiene fixture."""
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def reshape(x, new_dim):
+    assert x.size % new_dim == 0, "bad shape"       # expect: EH001
+    return x.reshape(-1, new_dim)
+
+
+class Scraper:
+    def start(self):
+        t = threading.Thread(target=self._scrape_loop, daemon=True)
+        t.start()
+
+    def _scrape_loop(self):
+        while True:
+            try:
+                self._scrape_once()
+            except Exception:                       # expect: EH002
+                pass
+
+    def _scrape_once(self):
+        raise NotImplementedError
+
+
+def handle(payload):
+    try:
+        return payload.decode()
+    except UnicodeDecodeError:
+        log.error("undecodable payload")            # expect: EH003
+        return None

@@ -1,0 +1,56 @@
+"""Known-bad lock-discipline fixture."""
+import threading
+import time
+
+
+class UnguardedWrites:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0                 # guarded-by: self._lock
+        self._items = []                # guarded-by: self._lock
+
+    def racy_assign(self):
+        self._count += 1                # expect: LK001
+
+    def racy_mutate(self, x):
+        self._items.append(x)           # expect: LK001
+
+    def racy_subscript(self, i):
+        with self._lock:
+            ok = self._count
+        self._items[i] = ok             # expect: LK001
+
+
+class BlockingUnderLock:
+    def __init__(self, sock, engine):
+        self._lock = threading.Lock()
+        self.sock = sock
+        self.engine = engine
+
+    def stall_sleep(self):
+        with self._lock:
+            time.sleep(1.0)             # expect: LK003
+
+    def stall_send(self, data):
+        with self._lock:
+            self.sock.sendall(data)     # expect: LK003
+
+    def stall_step(self):
+        with self._lock:
+            self.engine.step()          # expect: LK003
+
+
+class OrderAB:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def forward(self):
+        with self._a_lock:
+            with self._b_lock:          # a -> b
+                pass
+
+    def backward(self):
+        with self._b_lock:
+            with self._a_lock:          # expect: LK002
+                pass

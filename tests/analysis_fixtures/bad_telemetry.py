@@ -1,0 +1,28 @@
+"""Known-bad telemetry fixture (checked against the fixture-local
+OBSERVABILITY.md, which also documents `ptpu_fix_never_registered`
+that nothing here registers -> TS002 on the doc side)."""
+from paddle_tpu.utils.log import emit_event
+
+
+class Instrumented:
+    def __init__(self, registry):
+        self._m_ok = registry.counter(
+            "ptpu_fix_requests_total", "fine", labelnames=("reason",))
+        self._m_rogue = registry.counter(               # expect: TS001
+            "ptpu_fix_rogue_total", "undocumented")
+        self._m_kind = registry.counter(                # expect: TS003
+            "ptpu_fix_depth", "documented as a gauge")
+        self._m_labels = registry.counter(              # expect: TS003
+            "ptpu_fix_requests_total", "wrong labels",
+            labelnames=("reason", "shard"))
+        # the rest of the documented catalog, registered correctly, so
+        # the only TS002 left is the intentional never-registered row
+        self._m_lat = registry.histogram("ptpu_fix_latency_ms", "latency")
+        self._m_alpha = registry.counter("ptpu_fix_alpha_total", "a")
+        self._m_beta = registry.counter("ptpu_fix_beta_total", "b")
+        self._m_left = registry.gauge("ptpu_fix_left", "l")
+        self._m_right = registry.gauge("ptpu_fix_right", "r")
+
+    def record(self, req):
+        self._m_ok.labels(reason=f"c-{req.addr}").inc()  # expect: TS004
+        emit_event("rogue_stream", "boom")              # expect: TS005

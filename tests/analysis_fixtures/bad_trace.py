@@ -1,0 +1,62 @@
+"""Known-bad trace-purity fixture: every `# expect: RULE` line must be
+flagged with exactly that rule by the trace-purity pass.  Never
+imported or executed — the analyzer only parses it."""
+import time
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def hazard_host_effects(x):
+    t0 = time.perf_counter()                    # expect: TP001
+    print("tracing", t0)                        # expect: TP001
+    return x * 2
+
+
+@jax.jit
+def hazard_materialize(x):
+    host = np.asarray(x)                        # expect: TP002
+    peek = x.item()                             # expect: TP002
+    return x + float(host.shape[0]) + peek
+
+
+@jax.jit
+def hazard_branch(x):
+    if x > 0:                                   # expect: TP003
+        return x
+    return -x
+
+
+def _helper(y):
+    # reached transitively from the jitted root below
+    time.sleep(0.1)                             # expect: TP001
+    return y
+
+
+@jax.jit
+def hazard_transitive(y):
+    return _helper(y) + 1
+
+
+class Stepper:
+    def hazard_per_call(self, x):
+        # building + invoking the jit per call defeats the compile cache
+        return jax.jit(lambda v: v + 1)(x)      # expect: TP004
+
+    def hazard_loop(self, xs):
+        fns = []
+        for _ in xs:
+            fns.append(jax.jit(jnp.sin))        # expect: TP004
+        return fns
+
+
+class Metrics:
+    def __init__(self, registry):
+        self._m_steps = registry.counter("steps")
+
+    @jax.jit
+    def hazard_metric(self, x):
+        self._m_steps.inc()                     # expect: TP001
+        return x

@@ -1,0 +1,45 @@
+"""Known-good error-hygiene fixture: nothing here may be flagged."""
+import logging
+import threading
+
+log = logging.getLogger(__name__)
+
+
+def reshape(x, new_dim):
+    if x.size % new_dim != 0:
+        raise ValueError("bad shape")   # explicit raise survives -O
+    return x.reshape(-1, new_dim)
+
+
+class Scraper:
+    def __init__(self):
+        self._error = None
+
+    def start(self):
+        t = threading.Thread(target=self._scrape_loop, daemon=True)
+        t.start()
+
+    def _scrape_loop(self):
+        while True:
+            try:
+                self._scrape_once()
+            except Exception as e:
+                log.warning("scrape failed: %s", e)   # logged: not silent
+            try:
+                self._scrape_once()
+            except Exception as e:
+                self._error = e         # captured for a re-raising consumer
+
+    def _scrape_once(self):
+        raise NotImplementedError
+
+
+def handle(payload):
+    try:
+        return payload.decode()
+    except UnicodeDecodeError:
+        log.error("undecodable payload", exc_info=True)
+        return None
+    except ValueError:
+        log.error("bad payload")        # handler re-raises: traceback lives
+        raise

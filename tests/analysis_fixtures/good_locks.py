@@ -1,0 +1,32 @@
+"""Known-good lock-discipline fixture: nothing here may be flagged."""
+import threading
+
+
+class Disciplined:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._count = 0                 # guarded-by: self._lock
+        self._items = []                # guarded-by: self._lock
+        self.sock = sock                # __init__ writes are exempt
+
+    def locked_assign(self):
+        with self._lock:
+            self._count += 1
+
+    def locked_mutate(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._flush_locked()
+
+    # requires-lock: self._lock
+    def _flush_locked(self):
+        self._items.clear()             # caller holds the lock: fine
+
+    def send_outside(self, data):
+        with self._lock:
+            payload = list(self._items)
+        self.sock.sendall(payload)      # blocking AFTER the lock: fine
+
+    def consistent_order(self):
+        with self._lock:
+            pass                        # single lock: no order to violate

@@ -1,0 +1,25 @@
+"""Known-good telemetry fixture: registers every documented series
+(against the fixture-local OBSERVABILITY.md) with matching schemas."""
+from paddle_tpu.utils.log import emit_event, serve_event
+
+REASONS = ("eos", "length", "cancelled")
+
+
+class Instrumented:
+    def __init__(self, registry):
+        self._m_reqs = registry.counter(
+            "ptpu_fix_requests_total", "finished", labelnames=("reason",))
+        self._m_depth = registry.gauge("ptpu_fix_depth", "queue depth")
+        self._m_lat = registry.histogram("ptpu_fix_latency_ms", "latency")
+        self._m_alpha = registry.counter("ptpu_fix_alpha_total", "a")
+        self._m_beta = registry.counter("ptpu_fix_beta_total", "b")
+        self._m_left = registry.gauge("ptpu_fix_left", "l")
+        self._m_right = registry.gauge("ptpu_fix_right", "r")
+        self._m_never = registry.counter("ptpu_fix_never_registered", "n")
+
+    def record(self, reason, ms):
+        # label values from a bounded enum VARIABLE are fine
+        self._m_reqs.labels(reason=reason).inc()
+        self._m_lat.observe(ms)
+        emit_event("serve", "finished", reason=reason)
+        serve_event("finished_too", reason=reason)

@@ -1,0 +1,53 @@
+"""Known-good trace-purity fixture: nothing here may be flagged."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure_math(x, y):
+    z = jnp.where(x > 0, x, -x)         # data branch via jnp, not Python
+    return z @ y
+
+
+@jax.jit
+def none_guard(x, scale=None):
+    if scale is None:                   # trace-static dispatch: fine
+        scale = 1.0
+    return x * scale
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_branch(x, mode):
+    if mode:                            # static arg: branch is compile-time
+        return x * 2
+    return x
+
+
+def build_step(fn):
+    # one-time jit construction in a builder is the blessed pattern
+    return jax.jit(fn)
+
+
+class Engine:
+    def __init__(self, fn):
+        self._step = jax.jit(fn)        # constructed once, cached forever
+
+    def step(self, x):
+        t0 = time.perf_counter()        # host code: clocks are fine here
+        out = self._step(x)
+        self.last_ms = (time.perf_counter() - t0) * 1e3
+        return out
+
+
+def scan_sum(xs):
+    def body(carry, x):
+        return carry + x, carry         # pure combinator body
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def functional_update(kp, src, dst):
+    # .at[].set() is a jnp functional update, NOT a metric/gauge call
+    return kp.at[dst].set(kp[src])

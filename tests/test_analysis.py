@@ -1,0 +1,205 @@
+"""Tier-1 gate + unit tests for the graftlint static-analysis suite.
+
+The repo gate (`test_repo_gate_is_green`) is the ratchet: it runs every
+pass over paddle_tpu/ and tools/ and fails on any finding that is not in
+analysis_baseline.txt — injecting a recompile hazard or an unguarded
+guarded-by write anywhere in the tree turns this test red with the rule
+id and file:line (see the injection tests for the exact shape).
+
+Fixture expectations are comment-driven: each `# expect: RULE` marker in
+tests/analysis_fixtures/bad_*.py must produce exactly that rule on that
+line, and the fixture set must produce nothing else.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import (apply_baseline, format_baseline,
+                                 load_baseline, run_analysis)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+FIXTURE_DOC = os.path.join(FIXTURES, "OBSERVABILITY.md")
+BASELINE = os.path.join(REPO, "analysis_baseline.txt")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z]{2}\d{3})")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _rel(name: str) -> str:
+    return f"tests/analysis_fixtures/{name}"
+
+
+def _expected_markers(*names):
+    """(relpath, line, rule) for every `# expect:` marker in fixtures."""
+    out = set()
+    for name in names:
+        with open(_fixture(name), "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, start=1):
+                m = _EXPECT_RE.search(line)
+                if m:
+                    out.add((_rel(name), lineno, m.group(1)))
+    return out
+
+
+# -- the tier-1 ratchet ------------------------------------------------------
+
+def test_repo_gate_is_green():
+    findings = run_analysis(
+        [os.path.join(REPO, "paddle_tpu"), os.path.join(REPO, "tools")], REPO)
+    new, _suppressed, stale = apply_baseline(findings, load_baseline(BASELINE))
+    assert not new, "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, "stale baseline entries (finding fixed? remove the " \
+        "line from analysis_baseline.txt):\n" + "\n".join(stale)
+
+
+# -- fixture-driven pass tests ----------------------------------------------
+
+BAD = ["bad_trace.py", "bad_locks.py", "bad_telemetry.py", "bad_hygiene.py"]
+GOOD = ["good_trace.py", "good_locks.py", "good_telemetry.py",
+        "good_hygiene.py"]
+
+
+def test_bad_fixtures_flag_exactly_the_expected_rules():
+    findings = run_analysis([_fixture(n) for n in BAD], REPO,
+                            doc_path=FIXTURE_DOC)
+    actual = {(f.file, f.line, f.rule) for f in findings}
+    expected = _expected_markers(*BAD)
+    # the doc-side finding: bad_telemetry never registers this row
+    with open(FIXTURE_DOC, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, start=1):
+            if "ptpu_fix_never_registered" in line:
+                expected.add((_rel("OBSERVABILITY.md"), lineno, "TS002"))
+    missing = expected - actual
+    surplus = actual - expected
+    assert not missing, f"rules not flagged: {sorted(missing)}"
+    assert not surplus, f"unexpected findings (false positives): " \
+                        f"{sorted(surplus)}"
+
+
+def test_good_fixtures_stay_clean():
+    findings = run_analysis([_fixture(n) for n in GOOD], REPO,
+                            doc_path=FIXTURE_DOC)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_inline_disable_waives_a_finding(tmp_path):
+    src = (
+        "import time\nimport jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    time.time()  # graftlint: disable=TP001 -- trace-time only\n"
+        "    return x\n"
+    )
+    mod = tmp_path / "waived.py"
+    mod.write_text(src)
+    findings = run_analysis([str(mod)], str(tmp_path))
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# -- baseline workflow -------------------------------------------------------
+
+def test_baseline_suppression_round_trips(tmp_path):
+    findings = run_analysis([_fixture(n) for n in BAD], REPO,
+                            doc_path=FIXTURE_DOC)
+    assert findings
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(format_baseline(findings))
+    new, suppressed, stale = apply_baseline(findings,
+                                            load_baseline(str(bl)))
+    assert not new and not stale and suppressed == len(findings)
+
+    # dropping one entry resurfaces exactly that finding
+    keys = [ln for ln in bl.read_text().splitlines()
+            if ln and not ln.startswith("#")]
+    singles = [k for k in keys if keys.count(k) == 1]
+    drop = singles[0]
+    bl.write_text("\n".join(k for k in keys if k != drop) + "\n")
+    new, _, stale = apply_baseline(findings, load_baseline(str(bl)))
+    assert [f.baseline_key() for f in new] == [drop]
+    assert not stale
+
+    # an entry for a fixed finding is reported as stale
+    bl.write_text("\n".join(keys) + "\nsome/file.py::TP001::gone = 1\n")
+    new, _, stale = apply_baseline(findings, load_baseline(str(bl)))
+    assert not new
+    assert stale == ["some/file.py::TP001::gone = 1"]
+
+
+# -- the acceptance-criteria injections --------------------------------------
+
+def test_injected_recompile_hazard_fails_with_rule_and_line(tmp_path):
+    mod = tmp_path / "hazmod.py"
+    mod.write_text(
+        "import time\nimport jax\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t = time.time()\n"
+        "    return x + t\n"
+    )
+    findings = run_analysis([str(mod)], str(tmp_path))
+    assert [(f.file, f.line, f.rule) for f in findings] == \
+        [("hazmod.py", 6, "TP001")]
+
+
+def test_injected_unguarded_write_fails_with_rule_and_line(tmp_path):
+    mod = tmp_path / "racy.py"
+    mod.write_text(
+        "import threading\n\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # guarded-by: self._lock\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n"
+    )
+    findings = run_analysis([str(mod)], str(tmp_path))
+    assert [(f.file, f.line, f.rule) for f in findings] == \
+        [("racy.py", 8, "LK001")]
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", *args],
+        cwd=cwd, env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_cli_exits_zero_against_checked_in_baseline():
+    proc = _run_cli(["paddle_tpu", "tools"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_json_is_stable_and_fails_on_findings(tmp_path):
+    mod = tmp_path / "hazmod.py"
+    mod.write_text(
+        "import time\nimport jax\n\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    print(time.time())\n"
+        "    return x\n"
+    )
+    proc = _run_cli(["--json", "--no-baseline", "--root", str(tmp_path),
+                     str(mod)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is False
+    rows = [(f["file"], f["line"], f["rule"]) for f in doc["findings"]]
+    assert rows == sorted(rows), "JSON findings must be sorted"
+    assert ("hazmod.py", 6, "TP001") in rows
+    # byte-stable across runs
+    proc2 = _run_cli(["--json", "--no-baseline", "--root", str(tmp_path),
+                      str(mod)])
+    assert proc2.stdout == proc.stdout

@@ -12,6 +12,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 import jax
@@ -350,6 +351,79 @@ class TestRouter:
             router.stop()
             for fe in fes:
                 fe._teardown()
+
+
+# -- lock-discipline regressions -------------------------------------------
+
+class TestLockDiscipline:
+    """Regressions for the races the graftlint lock pass surfaced (see
+    ANALYSIS.md): the router's inflight gauge and replica-state
+    snapshot, and the frontend's drain accounting."""
+
+    def test_router_inflight_gauge_matches_count_under_contention(self):
+        # Never started: _track_inflight is pure accounting, no I/O.
+        router = Router(["http://127.0.0.1:9"])
+
+        def churn():
+            for _ in range(300):
+                router._track_inflight(+1)
+                router._track_inflight(-1)
+
+        threads = [threading.Thread(target=churn) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # The old code re-read the count outside the lock before setting
+        # the gauge, so crossing requests could leave it nonzero forever.
+        assert router._inflight == 0
+        assert router._m_inflight.value == 0.0
+
+    def test_plan_route_snapshot_survives_scrape_churn(self):
+        router = Router([f"http://127.0.0.1:{p}" for p in (7, 8, 9)])
+        stop = threading.Event()
+
+        def churn():     # stands in for the scrape loop's publishes
+            flip = False
+            while not stop.is_set():
+                flip = not flip
+                with router._lock:
+                    for i, r in enumerate(router.replicas):
+                        r.ready = flip or i == 0
+                        r.hit_rate = 0.9 if flip else 0.1
+                        r.queue_depth = float(i)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            primary = router.replicas[prefix_shard([1, 2, 3], 3)]
+            for _ in range(500):
+                plan = router.plan_route([1, 2, 3])
+                ids = [id(r) for r in plan]
+                assert id(primary) in ids       # sticky primary always tried
+                assert len(ids) == len(set(ids))
+                assert set(ids) <= {id(r) for r in router.replicas}
+        finally:
+            stop.set()
+            t.join()
+
+    def test_drain_finished_waits_for_open_streams(self, model_and_vars):
+        # Not started: _drain_finished is pure accounting over the
+        # engine scheduler and the handler counters.
+        model, variables = model_and_vars
+        fe = _frontend(model, variables)
+        fe._drain_started = time.monotonic()
+        with fe._lock:
+            fe._open_streams = 1    # a handler mid final write
+        assert not fe._drain_finished()
+        with fe._lock:
+            fe._open_streams = 0
+        assert fe._drain_finished()
+        # past the deadline an open stream no longer blocks the exit
+        with fe._lock:
+            fe._open_streams = 1
+        fe._drain_started = time.monotonic() - fe.drain_deadline_s - 1.0
+        assert fe._drain_finished()
 
 
 # -- subprocess smoke (the tier-1 end-to-end) ------------------------------
