@@ -9,10 +9,11 @@ through block tables (kernels/paged_attention.py).
 
 from paddle_tpu.engine.draft import NgramDrafter
 from paddle_tpu.engine.engine import ServeEngine, serve_metadata
+from paddle_tpu.engine.kvtier import HostKVTier, prefix_digest
 from paddle_tpu.engine.paged_cache import CacheExhausted, PagedKVCache
 from paddle_tpu.engine.scheduler import (PrefillChunk, Request, Scheduler,
                                          StepRow)
 
 __all__ = ["ServeEngine", "serve_metadata", "PagedKVCache",
            "CacheExhausted", "Scheduler", "Request", "StepRow",
-           "PrefillChunk", "NgramDrafter"]
+           "PrefillChunk", "NgramDrafter", "HostKVTier", "prefix_digest"]

@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core.module import Context, _CtxCore
+from paddle_tpu.engine.kvtier import HostKVTier, prefix_digest
 from paddle_tpu.engine.paged_cache import PagedKVCache
 from paddle_tpu.engine.scheduler import (RUNNING, Request, Scheduler,
                                          StepRow)
@@ -78,6 +79,7 @@ from paddle_tpu.obs.tracing import RequestTracer
 from paddle_tpu.utils.log import serve_event
 
 _COPY_LANES = 8     # COW copies flushed through one fixed-shape call
+_TIER_LANES = 8     # host-tier revivals flushed per fixed-shape write
 
 
 def _fresh_cx(variables) -> Context:
@@ -162,7 +164,9 @@ class ServeEngine:
                  spec_k: int = 0,
                  drafter=None,
                  registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[RequestTracer] = None):
+                 tracer: Optional[RequestTracer] = None,
+                 host_tier_bytes: int = 0,
+                 kv_tier_int8: bool = False):
         self.model = model
         self.variables = variables
         # telemetry (OBSERVABILITY.md): None -> the process registry /
@@ -215,11 +219,35 @@ class ServeEngine:
             -(-max_prefill_tokens // tile_q) * tile_q
             + max_batch_size * (-(-self.spec_len // tile_q) * tile_q))
         self.num_tiles = self.flat_tokens // tile_q
+        # host-RAM KV tier (engine/kvtier.py): a byte budget > 0 hangs
+        # a second tier behind the pool — cached-free evictions and
+        # preemptions demote block KV to host (int8-quantized when
+        # kv_tier_int8), and admission revives it by DMA instead of
+        # re-prefill. All tier traffic is host-side numpy plus eager
+        # .at[].set() pool writes: the one-compile invariant holds.
+        self.host_tier = (
+            HostKVTier(host_tier_bytes, int8=kv_tier_int8,
+                       registry=self.obs)
+            if host_tier_bytes > 0 else None)
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
             head_dim=attn.head_dim, dtype=model.dtype,
-            enable_prefix_cache=enable_prefix_cache, registry=self.obs)
+            enable_prefix_cache=enable_prefix_cache, registry=self.obs,
+            host_tier=self.host_tier)
+        if self.host_tier is not None:
+            # prime the eager kernels tier traffic dispatches — the
+            # demote gather (pool[block] device_get) and the revival
+            # scatter (_TIER_LANES-wide .at[].set) — with no-op writes
+            # to scratch block 0, so the first real demotion/revival
+            # never pays their one-time XLA compile mid-request.
+            kp0, vp0 = self.cache.pools[0]
+            lanes = jnp.zeros((_TIER_LANES,), jnp.int32)
+            zero = jnp.zeros((_TIER_LANES,) + tuple(kp0.shape[1:]),
+                             kp0.dtype)
+            np.asarray(kp0[0])        # the demote gather's signature
+            self.cache.pools[0] = (kp0.at[lanes].set(zero),
+                                   vp0.at[lanes].set(zero))
         self.max_blocks_per_seq = self.cache.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(
             self.cache, max_batch_size=max_batch_size,
@@ -516,6 +544,49 @@ class ServeEngine:
             self.cache.pools = self._copy_blocks(
                 self.cache.pools, jnp.asarray(src), jnp.asarray(dst))
 
+    def _flush_tier_loads(self) -> None:
+        """Write staged host-tier revivals into the device pools —
+        BEFORE _flush_cow (a just-revived block can be a same-plan COW
+        src) and before the step reads them. Eager functional
+        .at[blocks].set(...) writes in FIXED-WIDTH _TIER_LANES batches
+        (unused lanes write zeros to scratch block 0, the _flush_cow
+        idiom) — the shape signature never varies with revival size,
+        so XLA compiles the scatter exactly once. No new jit entry
+        points: the jit cache stays at 1."""
+        loads = self.cache.drain_host_loads()
+        for i in range(0, len(loads), _TIER_LANES):
+            batch = loads[i:i + _TIER_LANES]
+            idx = np.zeros((_TIER_LANES,), np.int32)
+            for j, (b, _) in enumerate(batch):
+                idx[j] = b       # pad lanes write zeros to scratch block 0
+            blocks = jnp.asarray(idx)
+            for li, (kp, vp) in enumerate(self.cache.pools):
+                kd = np.zeros((_TIER_LANES,) + tuple(kp.shape[1:]),
+                              np.float32)
+                vd = np.zeros((_TIER_LANES,) + tuple(vp.shape[1:]),
+                              np.float32)
+                for j, (_, layers) in enumerate(batch):
+                    kd[j], vd[j] = layers[li]
+                self.cache.pools[li] = (
+                    kp.at[blocks].set(jnp.asarray(kd, kp.dtype)),
+                    vp.at[blocks].set(jnp.asarray(vd, vp.dtype)))
+
+    def kv_prefix_directory(self, limit: int = 512) -> List[dict]:
+        """This replica's fleet-directory advertisement: the warm
+        prefixes it can serve without re-prefill, as
+        {len, digest, tier} rows (device = prefix-index entries, host =
+        tier entries). Digests are crc32 over little-endian u32 token
+        ids — the same encoding the router's prefix_shard hashes.
+        Engine-loop thread only (reads the unlocked prefix index); the
+        serve front-end snapshots it between steps for /kvprefixes."""
+        out = [{"len": len(key), "digest": prefix_digest(key),
+                "tier": "device"}
+               for key in self.cache.prefix_keys(limit)]
+        if self.host_tier is not None:
+            out.extend({"len": ln, "digest": dg, "tier": "host"}
+                       for ln, dg in self.host_tier.advertised(limit))
+        return out
+
     def _step_mixed(self, rows: List[StepRow]
                     ) -> "tuple[int, int, int, int]":
         """Pack the plan's rows — decode rows AND prefill chunks — into
@@ -536,6 +607,7 @@ class ServeEngine:
         speculative rows gather one hidden state per window position
         for verification; every other row repeats its single real
         index across the columns."""
+        self._flush_tier_loads()
         self._flush_cow()
         t_flat, tq, nt = self.flat_tokens, self.tile_q, self.num_tiles
         b = self.max_batch_size

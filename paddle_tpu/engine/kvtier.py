@@ -1,0 +1,212 @@
+"""Host-RAM KV tier: the second tier behind PagedKVCache.
+
+Device HBM is the capacity wall of continuous batching (ROADMAP
+"Tiered, fleet-wide KV"): the block pool is single-tier, so preemption
+is recompute-only (quadratic in context) and cached-free prefix blocks
+die the moment `_pop_free` recycles them. This module keeps that KV
+alive one tier down:
+
+- DEMOTION. When the pool is about to destroy cached content — a
+  cached-free block handed out for fresh tokens, or a preempted
+  sequence's committed blocks — the full block rows are device_get
+  into host buffers, keyed by the SAME content token tuple the prefix
+  index uses (the key IS the content, so the tier inherits the index's
+  collision-free identity).
+- REVIVAL. `PagedKVCache.alloc_sequence` walks a new prompt past its
+  device-index match into this tier; every host hit claims a fresh
+  device block and stages a (block, layers) load the engine flushes
+  with functional `pool.at[block].set(...)` writes BEFORE the step
+  that reads them — a DMA instead of a re-prefill. Tier traffic is
+  entirely host-side: no new jit, the one-compile invariant holds.
+- BUDGET. Entries live in an LRU ordered by last touch under a byte
+  budget; demotions past the budget evict the coldest entries.
+- INT8 MODE. `int8=True` stores blocks quantized with the symmetric
+  abs-max scheme from paddle_tpu/quant/int8_compute.py (one scale per
+  k/v array per layer per block), roughly doubling effective tier
+  capacity; revival dequantizes. fp mode is bit-exact round-trip; the
+  int8 tier is exact to within scale/127 per element
+  (tests/test_kvtier.py gates the bound).
+
+The tier is thread-safe: the engine loop mutates it while the serve
+front-end's handler threads read `advertised()` for the fleet prefix
+directory (serve/router.py) — replicas advertise (prefix length,
+crc32 digest, tier) and the router prefers the replica holding the
+longest warm prefix at the hottest tier.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
+from paddle_tpu.quant.int8_compute import (dequantize_host_int8,
+                                           quantize_host_int8)
+
+# per-layer block payload as the cache hands it over / gets it back:
+# [(k_block, v_block), ...] — one (block_size, Hkv, hd) pair per layer
+BlockLayers = List[Tuple[np.ndarray, np.ndarray]]
+
+
+def prefix_digest(tokens: Sequence[int]) -> str:
+    """Stable 8-hex-digit digest of a token prefix: crc32 over the ids
+    as little-endian u32 — the same encoding `router.prefix_shard`
+    hashes, so every process derives identical digests. Used only for
+    fleet directory ADVERTISEMENT (a collision can misroute, never
+    corrupt: the receiving replica re-matches on exact tokens)."""
+    raw = b"".join(int(t & 0xFFFFFFFF).to_bytes(4, "little")
+                   for t in tokens)
+    return format(zlib.crc32(raw), "08x")
+
+
+class _Entry:
+    """One demoted block: per-layer payloads + resident byte count.
+    Payloads are immutable after construction, so readers may touch
+    them outside the tier lock."""
+
+    __slots__ = ("blobs", "nbytes")
+
+    def __init__(self, blobs: list, nbytes: int):
+        self.blobs = blobs
+        self.nbytes = nbytes
+
+
+class HostKVTier:
+    """LRU byte-budgeted host store of full KV blocks, keyed by the
+    prefix index's content token tuples. `int8=True` quantizes on
+    demotion and dequantizes on revival."""
+
+    def __init__(self, byte_budget: int, int8: bool = False,
+                 registry: Optional[MetricsRegistry] = None):
+        if byte_budget <= 0:
+            raise ValueError(f"byte_budget {byte_budget} <= 0")
+        self.byte_budget = int(byte_budget)
+        self.int8 = bool(int8)
+        # One lock covers the entry map and the byte counter; payload
+        # arrays are immutable so get()/advertised() only need it for
+        # the map touch, never for the (de)quantize work.
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = \
+            OrderedDict()                    # guarded-by: self._lock
+        self._bytes = 0                      # guarded-by: self._lock
+        reg = registry if registry is not None else default_registry()
+        self._c_demoted = reg.counter(
+            "ptpu_kv_tier_demoted_blocks_total",
+            "KV blocks copied out to the host tier",
+            labelnames=("reason",))          # reason=evict|preempt
+        self._c_revived = reg.counter(
+            "ptpu_kv_tier_revived_blocks_total",
+            "Host-tier blocks revived into the device pool")
+        self._c_revived_toks = reg.counter(
+            "ptpu_kv_tier_revived_tokens_total",
+            "Prompt tokens served from the host tier instead of "
+            "re-prefill")
+        self._c_lru = reg.counter(
+            "ptpu_kv_tier_lru_evictions_total",
+            "Host-tier entries dropped by the LRU byte budget")
+        self._g_bytes = reg.gauge(
+            "ptpu_kv_tier_bytes", "Host-tier resident bytes")
+        self._g_entries = reg.gauge(
+            "ptpu_kv_tier_entries", "Host-tier resident block entries")
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def contains(self, key: tuple) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    # -- demotion ---------------------------------------------------------
+    def put(self, key: tuple, layers: BlockLayers,
+            reason: str = "evict") -> bool:
+        """Store one full block's per-layer KV under `key`. Quantizes
+        in int8 mode, charges the byte budget, and LRU-evicts the
+        coldest entries while over it. Returns False when the single
+        block exceeds the whole budget (nothing stored)."""
+        blobs = []
+        nbytes = 0
+        for k, v in layers:
+            k = np.asarray(k)
+            v = np.asarray(v)
+            if self.int8:
+                kq, ks = quantize_host_int8(k)
+                vq, vs = quantize_host_int8(v)
+                blobs.append((kq, ks, vq, vs, k.dtype))
+                nbytes += kq.nbytes + vq.nbytes + 16
+            else:
+                blobs.append((k, v))
+                nbytes += k.nbytes + v.nbytes
+        if nbytes > self.byte_budget:
+            return False
+        lru_evicted = 0
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            self._entries[key] = _Entry(blobs, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.byte_budget:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
+                lru_evicted += 1
+            bytes_now, count = self._bytes, len(self._entries)
+        self._c_demoted.labels(reason=reason).inc()
+        if lru_evicted:
+            self._c_lru.inc(lru_evicted)
+        self._g_bytes.set(float(bytes_now))
+        self._g_entries.set(float(count))
+        return True
+
+    # -- revival ----------------------------------------------------------
+    def get(self, key: tuple) -> Optional[BlockLayers]:
+        """Per-layer (k, v) float arrays for a stored block (LRU touch),
+        or None. The entry stays resident — one host copy can revive
+        onto any number of device blocks over its lifetime."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            self._entries.move_to_end(key)
+            blobs = entry.blobs
+        if not self.int8:
+            return list(blobs)
+        return [(dequantize_host_int8(kq, ks, dtype),
+                 dequantize_host_int8(vq, vs, dtype))
+                for kq, ks, vq, vs, dtype in blobs]
+
+    def note_revived(self, blocks: int, tokens: int) -> None:
+        """The cache revived `blocks` host blocks covering `tokens`
+        prompt tokens at admission (telemetry only)."""
+        if blocks:
+            self._c_revived.inc(blocks)
+        if tokens:
+            self._c_revived_toks.inc(tokens)
+
+    # -- fleet directory --------------------------------------------------
+    def advertised(self, limit: int = 512) -> List[Tuple[int, str]]:
+        """(prefix length, digest) for the most recently touched
+        entries — what a replica publishes on /kvprefixes for the
+        router's fleet prefix directory. Thread-safe."""
+        with self._lock:
+            keys = list(self._entries.keys())
+        if limit and len(keys) > limit:
+            keys = keys[-limit:]
+        return [(len(k), prefix_digest(k)) for k in keys]
+
+    # -- observability ----------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"tier_entries": len(self._entries),
+                    "tier_bytes": self._bytes,
+                    "tier_int8": self.int8}

@@ -51,11 +51,15 @@ sequence.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
+
+if TYPE_CHECKING:
+    from paddle_tpu.engine.kvtier import HostKVTier
 
 
 class CacheExhausted(Exception):
@@ -73,7 +77,8 @@ class PagedKVCache:
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  num_kv_heads: int, head_dim: int, dtype=jnp.float32,
                  enable_prefix_cache: bool = True,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 host_tier: Optional["HostKVTier"] = None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
         self.num_blocks = num_blocks
@@ -104,6 +109,15 @@ class PagedKVCache:
         self._index: Dict[tuple, int] = {}
         self._key_of: Dict[int, tuple] = {}           # block -> index key
         self._pending_copies: List[Tuple[int, int]] = []   # (src, dst)
+        # optional host-RAM second tier (engine/kvtier.py): blocks the
+        # pool is about to destroy are copied out, and alloc_sequence
+        # walks it past the device index. Revivals stage (block, layers)
+        # loads here; the engine flushes them into the device pools
+        # (drain_host_loads) BEFORE any step reads or COW-copies them.
+        self.host_tier = host_tier
+        self._pending_host_loads: List[Tuple[int, list]] = []
+        self.tier_revivals = 0            # host-tier blocks revived
+        self.tier_hit_tokens = 0          # prompt tokens covered by them
         # cumulative stats (serve_event / bench verdicts)
         self.hit_tokens = 0
         self.prompt_tokens = 0
@@ -161,14 +175,50 @@ class PagedKVCache:
         cached-free index entry it still carries (freed blocks keep
         their prefix KV reusable until the pool actually needs them —
         free_sequence appends to the RIGHT and this pops from the LEFT,
-        so the longest-freed cached content is evicted first)."""
+        so the longest-freed cached content is evicted first). With a
+        host tier attached the content is demoted before the entry
+        dies — eviction becomes a tier transition, not a loss."""
         block = self._free.popleft()
         key = self._key_of.pop(block, None)
         if key is not None and self._index.get(key) == block:
+            self._demote_block(block, key, "evict")
             del self._index[key]
             self.cached_free_evictions += 1
             self._c_evict.inc()
         return block
+
+    def _demote_block(self, block: int, key: tuple, reason: str) -> bool:
+        """device_get one committed block's KV (every layer) into the
+        host tier under its content key. No-op without a tier or when
+        the tier already holds the key (a revived-but-unflushed block
+        would otherwise read back garbage — the tier copy is the truth
+        until the staged load lands)."""
+        if self.host_tier is None or self.host_tier.contains(key):
+            return False
+        layers = [(np.asarray(kp[block]), np.asarray(vp[block]))
+                  for kp, vp in self.pools]
+        return self.host_tier.put(key, layers, reason=reason)
+
+    def demote_sequence(self, seq_id: int) -> int:
+        """Copy a live sequence's committed full blocks out to the host
+        tier — the preemption path: the scheduler calls this right
+        before free_sequence so re-admission revives the context by DMA
+        instead of re-prefilling it (quadratic recompute becomes a
+        linear copy). Returns blocks demoted."""
+        if self.host_tier is None or not self.enable_prefix_cache:
+            return 0
+        table = self._tables.get(seq_id)
+        if table is None:
+            return 0
+        self._register_full_blocks(seq_id)
+        toks = self._tokens[seq_id]
+        bs = self.block_size
+        count = 0
+        for bi in range(self._committed.get(seq_id, 0) // bs):
+            key = self._key_of.get(table[bi]) or tuple(toks[:(bi + 1) * bs])
+            if self._demote_block(table[bi], key, "preempt"):
+                count += 1
+        return count
 
     def _match_prefix(self, tokens: Sequence[int]) -> List[int]:
         """Longest run of committed full blocks matching `tokens`' head
@@ -213,7 +263,18 @@ class PagedKVCache:
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
         n = len(tokens)
+        bs = self.block_size
         matched = self._match_prefix(tokens)
+        # walk PAST the device match into the host tier: every hit is
+        # fetched now (the payload is pinned here — a later demotion's
+        # LRU eviction between admission and flush can't revoke it)
+        host_loads: List[Tuple[tuple, list]] = []
+        if self.host_tier is not None and self.enable_prefix_cache:
+            for end in range((len(matched) + 1) * bs, n + 1, bs):
+                layers = self.host_tier.get(tuple(tokens[:end]))
+                if layers is None:
+                    break
+                host_loads.append((tuple(tokens[:end]), layers))
         need = self.blocks_for(n) - len(matched)
         revive = [b for b in matched if b not in self._refs]
         if need + len(revive) > len(self._free):
@@ -227,14 +288,31 @@ class PagedKVCache:
                 self._refs[b] = 1
                 self.cached_free_revivals += 1
                 self._c_revive.inc()
-        fresh = [self._pop_free() for _ in range(need)]
+        # host-tier hits claim fresh device blocks and stage their DMA;
+        # the key registers first-wins so later prompts can share the
+        # block as soon as the engine flushes the load
+        host_blocks: List[int] = []
+        for key, layers in host_loads:
+            b = self._pop_free()
+            self._refs[b] = 1
+            host_blocks.append(b)
+            self._pending_host_loads.append((b, layers))
+            if key not in self._index and b not in self._key_of:
+                self._index[key] = b
+                self._key_of[b] = key
+        fresh = [self._pop_free() for _ in range(need - len(host_blocks))]
         for b in fresh:
             self._refs[b] = 1
-        self._tables[seq_id] = matched + fresh
+        self._tables[seq_id] = matched + host_blocks + fresh
         self._lens[seq_id] = n
         self._tokens[seq_id] = list(tokens)
-        cached = min(len(matched) * self.block_size, n - 1)
+        cached = min((len(matched) + len(host_blocks)) * bs, n - 1)
         self._committed[seq_id] = cached
+        if host_blocks:
+            tier_toks = max(0, cached - len(matched) * bs)
+            self.tier_revivals += len(host_blocks)
+            self.tier_hit_tokens += tier_toks
+            self.host_tier.note_revived(len(host_blocks), tier_toks)
         if count_stats:
             self.hit_tokens += cached
             self.prompt_tokens += n
@@ -270,6 +348,14 @@ class PagedKVCache:
         device pools (src block -> dst block, every layer) before the
         next prefill/decode call reads or writes the dst blocks."""
         out, self._pending_copies = self._pending_copies, []
+        return out
+
+    def drain_host_loads(self) -> List[Tuple[int, list]]:
+        """Staged host-tier revivals: (block, per-layer [(k, v)] host
+        arrays). The engine MUST write them into the device pools
+        BEFORE draining COW copies — a just-revived block can be the
+        src of a same-plan copy-on-write."""
+        out, self._pending_host_loads = self._pending_host_loads, []
         return out
 
     def commit_prefill(self, seq_id: int, upto: int) -> None:
@@ -402,6 +488,22 @@ class PagedKVCache:
             self._pending_copies = [
                 (s, d) for s, d in self._pending_copies
                 if d not in freed_set]
+        if freed_set and self._pending_host_loads:
+            # cancel-mid-revival: the request died before its staged
+            # host loads flushed. The freed blocks were index-registered
+            # for content that never arrived — deregister them (the
+            # host tier still holds the data; a re-request revives it
+            # onto new blocks).
+            stale = [b for b, _ in self._pending_host_loads
+                     if b in freed_set]
+            if stale:
+                self._pending_host_loads = [
+                    (b, la) for b, la in self._pending_host_loads
+                    if b not in freed_set]
+                for b in stale:
+                    key = self._key_of.pop(b, None)
+                    if key is not None and self._index.get(key) == b:
+                        del self._index[key]
         return freed
 
     # -- views for the jitted step ---------------------------------------
@@ -426,13 +528,20 @@ class PagedKVCache:
                              f"> max {max_blocks}")
         return table + [0] * (max_blocks - len(table))
 
+    def prefix_keys(self, limit: int = 512) -> List[tuple]:
+        """Most recently indexed prefix keys (device tier) — the
+        engine's half of the fleet prefix directory advertisement.
+        Engine-loop thread only (reads the unlocked index)."""
+        keys = list(self._index.keys())
+        return keys[-limit:] if limit and len(keys) > limit else keys
+
     # -- observability ----------------------------------------------------
     def hit_rate(self) -> float:
         """Fraction of all prompt tokens served from the prefix cache."""
         return self.hit_tokens / max(1, self.prompt_tokens)
 
     def stats(self) -> Dict[str, float]:
-        return {
+        out = {
             "hit_tokens": self.hit_tokens,
             "prompt_tokens": self.prompt_tokens,
             "hit_rate": round(self.hit_rate(), 4),
@@ -443,10 +552,16 @@ class PagedKVCache:
             "used_blocks": self.used_blocks,
             "occupancy": round(self.occupancy(), 4),
         }
+        if self.host_tier is not None:
+            out["tier_revivals"] = self.tier_revivals
+            out["tier_hit_tokens"] = self.tier_hit_tokens
+            out.update(self.host_tier.stats())
+        return out
 
     def reset_stats(self) -> None:
         self.hit_tokens = self.prompt_tokens = self.cow_copies = 0
         self.cached_free_evictions = self.cached_free_revivals = 0
+        self.tier_revivals = self.tier_hit_tokens = 0
 
     def assert_quiesced(self) -> None:
         """Leak check: with no live sequences every refcount must be
@@ -457,6 +572,10 @@ class PagedKVCache:
             raise RuntimeError(f"live sequences: {list(self._tables)}")
         if self._refs:
             raise RuntimeError(f"leaked refcounts: {self._refs}")
+        if self._pending_host_loads:
+            raise RuntimeError(
+                f"{len(self._pending_host_loads)} host-tier loads never "
+                "flushed")
         if len(self._free) != self.num_blocks - 1:
             raise RuntimeError(
                 f"free list {len(self._free)} != {self.num_blocks - 1}")

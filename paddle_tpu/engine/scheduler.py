@@ -323,24 +323,56 @@ class Scheduler:
                 self.preempt(victim)
         return False
 
+    def _preempt_cost(self, req: Request) -> float:
+        """Modeled cost of evicting `req` and bringing it back. Without
+        a host tier every committed token re-prefills, and attention
+        over the growing context makes that superlinear: ~n^2. With a
+        tier, committed FULL blocks swap out and revive by DMA (linear
+        in bytes ~ n) and only the uncommitted tail re-prefills
+        (~tail^2) — which is why long-context victims flip from worst
+        choice to best under a tier."""
+        n = len(req.tokens)
+        if self.cache.host_tier is None:
+            return float(n * n)
+        full = (n // self.cache.block_size) * self.cache.block_size
+        tail = n - full
+        return float(full + tail * tail)
+
     def _pick_victim(self, keep: Request) -> Optional[Request]:
         """The running request (other than `keep`) with the MOST
         deadline slack — a recompute preemption costs its victim a full
         re-prefill, so it should land on the request that can best
         absorb it. Without deadlines every slack is +inf and the choice
         degrades to the original deterministic rule: last admitted.
+        With a host tier attached, equal-slack candidates are split by
+        the swap-vs-recompute cost model instead (cheapest round-trip
+        loses its blocks); without one the legacy rule is bit-exact.
         None when nothing else is left to evict."""
-        best: Optional[Request] = None
+        if self.cache.host_tier is None:
+            best: Optional[Request] = None
+            for r in self.running:      # later index wins ties (stable max)
+                if r is not keep and (best is None
+                                      or r.deadline >= best.deadline):
+                    best = r
+            return best
+        best = None
+        best_cost = 0.0
         for r in self.running:          # later index wins ties (stable max)
-            if r is not keep and (best is None
-                                  or r.deadline >= best.deadline):
-                best = r
+            if r is keep:
+                continue
+            cost = self._preempt_cost(r)
+            if (best is None or r.deadline > best.deadline
+                    or (r.deadline == best.deadline and cost <= best_cost)):
+                best, best_cost = r, cost
         return best
 
     def preempt(self, req: Request) -> None:
         """Evict by recompute: drop block refs, fold generated tokens
         into the prompt, and requeue at the FRONT so it re-prefills
-        first."""
+        first. With a host tier the committed blocks demote first —
+        re-admission then revives them by DMA and only the tail
+        recomputes."""
+        self.cache.demote_sequence(req.req_id)
         self.cache.free_sequence(req.req_id)
         self.running.remove(req)
         req.preempt_carry += len(req.generated)

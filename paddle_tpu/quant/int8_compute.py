@@ -36,6 +36,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from paddle_tpu.core.module import Context, Module, PARAMS, Variables
@@ -258,3 +259,25 @@ def freeze_int8(module: Module, variables: Variables, calib_batches=None
                              "pass None for dynamic activation scales")
         _set_flag(module, "static_act", True)
     return module, out
+
+
+# -- host-side KV block quantization (engine/kvtier.py) ----------------------
+# The host KV tier stores demoted cache blocks in int8 to double its
+# effective byte budget. Same symmetric abs-max scheme as _quant_with,
+# but pure numpy: demotion/revival are host-RAM traffic and must not
+# touch the device (the engine's jit cache stays at exactly 1).
+
+def quantize_host_int8(x: np.ndarray) -> Tuple[np.ndarray, float]:
+    """Per-tensor symmetric abs-max int8 quantization on the host.
+    Returns (int8 array, float scale) with scale = max|x| (dequant is
+    q * scale / QMAX, mirroring the device-side y32 rescale)."""
+    xf = np.asarray(x, dtype=np.float32)
+    scale = float(max(np.max(np.abs(xf)), 1e-12))
+    q = np.clip(np.round(xf / scale * QMAX), -QMAX, QMAX)
+    return q.astype(np.int8), scale
+
+
+def dequantize_host_int8(q: np.ndarray, scale: float, dtype) -> np.ndarray:
+    """Inverse of quantize_host_int8; max abs error is scale / QMAX
+    per element (one quantization step)."""
+    return (np.asarray(q, np.float32) * (scale / QMAX)).astype(dtype)

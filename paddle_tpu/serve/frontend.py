@@ -66,6 +66,8 @@ from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
 from paddle_tpu.serve.sse import DONE_SENTINEL, sse_event
 from paddle_tpu.utils.log import serve_event
 
+_DIR_INTERVAL_S = 0.25   # /kvprefixes snapshot refresh cadence
+
 
 class _Stream:
     """Plumbing for one in-flight completion GROUP (1 primary +
@@ -122,6 +124,12 @@ class ServeFrontend:
         self._lock = threading.Lock()
         self._active: Dict[int, _Stream] = {}    # guarded-by: self._lock
         self._open_streams = 0               # guarded-by: self._lock
+        # fleet prefix directory advertisement (/kvprefixes): the
+        # engine loop snapshots {len, digest, tier} rows from the
+        # prefix index + host tier every _DIR_INTERVAL_S; handler
+        # threads serve the snapshot (never touch the engine)
+        self._directory: List[dict] = []     # guarded-by: self._lock
+        self._dir_next = 0.0                 # engine-loop thread only
         self._draining = False
         self._drain_started = 0.0
         self._stop_requested = False
@@ -282,6 +290,12 @@ class ServeFrontend:
                 if eng.scheduler.has_work():
                     progressed = eng.step()
                     self._flush_finished()
+                now = time.monotonic()
+                if now >= self._dir_next:
+                    self._dir_next = now + _DIR_INTERVAL_S
+                    snapshot = eng.kv_prefix_directory()
+                    with self._lock:
+                        self._directory = snapshot
                 if self._draining:
                     if self._drain_finished():
                         break
@@ -420,11 +434,19 @@ class ServeFrontend:
                     self._m_drain_cancelled.inc()
             s.q.put(("done", "cancelled", [], None))
 
+    def _directory_payload(self) -> dict:
+        """The /kvprefixes body: this replica's warm-prefix
+        advertisement for the router's fleet prefix directory."""
+        with self._lock:
+            return {"prefixes": list(self._directory)}
+
     # -- HTTP handlers ----------------------------------------------------
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
         self._set_ready_gauge()     # traffic may have warmed the engine
-        resp = obs_response(h.path, self.obs, readiness=self.readiness,
-                            routes={"/slo": json_route(self.slo.verdict)})
+        resp = obs_response(
+            h.path, self.obs, readiness=self.readiness,
+            routes={"/slo": json_route(self.slo.verdict),
+                    "/kvprefixes": json_route(self._directory_payload)})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
         self._send(h, *resp)

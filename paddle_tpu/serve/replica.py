@@ -50,6 +50,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spec-k", type=int, default=0,
                    help="speculative draft length (0 disables; > 0 "
                         "turns on the n-gram self-drafter)")
+    p.add_argument("--host-tier-bytes", type=int, default=0,
+                   help="host-RAM KV tier byte budget (0 disables; "
+                        "> 0 demotes evicted/preempted blocks to host "
+                        "and revives them by DMA — engine/kvtier.py)")
+    p.add_argument("--kv-tier-int8", action="store_true",
+                   help="store host-tier blocks int8-quantized "
+                        "(roughly doubles the tier's effective budget)")
     # front-end / admission / drain
     p.add_argument("--max-queue-depth", type=int, default=64)
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
@@ -83,7 +90,9 @@ def build_frontend(a: argparse.Namespace):
             block_size=a.block_size, num_blocks=a.num_blocks,
             max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
             enable_prefix_cache=not a.no_prefix_cache,
-            spec_k=a.spec_k, registry=registry)
+            spec_k=a.spec_k, registry=registry,
+            host_tier_bytes=a.host_tier_bytes,
+            kv_tier_int8=a.kv_tier_int8)
     else:
         import jax
         import jax.numpy as jnp
@@ -100,7 +109,9 @@ def build_frontend(a: argparse.Namespace):
             block_size=a.block_size, num_blocks=a.num_blocks,
             max_prefill_tokens=a.max_prefill_tokens, tile_q=a.tile_q,
             enable_prefix_cache=not a.no_prefix_cache,
-            spec_k=a.spec_k, registry=registry)
+            spec_k=a.spec_k, registry=registry,
+            host_tier_bytes=a.host_tier_bytes,
+            kv_tier_int8=a.kv_tier_int8)
     slo = SLOMonitor(
         registry,
         objectives=default_objectives(

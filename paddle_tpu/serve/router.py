@@ -14,6 +14,20 @@ replicas' own scraped telemetry — the router holds no model state:
   hashes to the same replica, so the fleet-wide hit rate tracks the
   single-replica hit rate instead of decaying ~1/N (serve_bench's
   router scenario measures exactly this).
+- FLEET PREFIX DIRECTORY. The hash is a degenerate directory (it
+  predicts where a prefix SHOULD be warm); the real one is scraped:
+  each replica advertises its warm prefixes on /kvprefixes as
+  (length, crc32 digest, tier) rows — "device" for prefix-index
+  blocks still in the pool, "host" for blocks demoted to the RAM tier
+  (engine/kvtier.py). plan_route checks the incoming prompt against
+  the directory and prefers the READY replica holding the LONGEST
+  matching prefix at the HOTTEST tier (device beats host beats
+  nothing), falling back to the hash primary when no replica has it.
+  After a restart, rebalance, or failover the directory finds warm KV
+  wherever it actually lives instead of where the hash says it should.
+  A digest collision can only misroute (the receiving replica
+  re-matches on exact tokens before reusing anything) — a perf risk,
+  never a correctness one.
 - TELEMETRY-RANKED FALLBACK. When the primary is not routable (failed
   /readyz: cold or draining; scrape failure; or it sheds 503), the
   request falls back to the remaining ready replicas ranked by their
@@ -61,11 +75,27 @@ def prefix_shard(prompt: Sequence[int], n: int, prefix_len: int = 32) -> int:
     return zlib.crc32(raw) % max(n, 1)
 
 
+def prefix_digest(tokens: Sequence[int]) -> str:
+    """8-hex-digit digest of a token prefix: crc32 over the ids as
+    little-endian u32. MUST match engine/kvtier.py's prefix_digest
+    (the replica side of the /kvprefixes advertisement) — duplicated
+    here so a standalone router never imports the engine stack;
+    tests/test_kvtier.py pins the two functions equal."""
+    raw = b"".join(int(t & 0xFFFFFFFF).to_bytes(4, "little")
+                   for t in tokens)
+    return format(zlib.crc32(raw), "08x")
+
+
+# directory tier ranking: a device-resident prefix serves with zero
+# copies, a host-tier one needs a DMA revival, anything else re-prefills
+_TIER_RANK = {"device": 1, "host": 0}
+
+
 class ReplicaState:
     """What the scrape loop knows about one replica right now."""
 
     __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
-                 "queue_depth", "last_scrape")
+                 "queue_depth", "last_scrape", "prefixes")
 
     def __init__(self, url: str):
         parts = urlsplit(url)
@@ -77,6 +107,8 @@ class ReplicaState:
         self.hit_rate = 0.0
         self.queue_depth = 0.0
         self.last_scrape = 0.0
+        # fleet prefix directory rows: {(len, digest): tier}
+        self.prefixes: Dict[Tuple[int, str], str] = {}
 
 
 class Router:
@@ -89,13 +121,16 @@ class Router:
                  prefix_len: int = 32,
                  scrape_interval_s: float = 0.5,
                  drain_deadline_s: float = 30.0,
-                 connect_timeout_s: float = 10.0):
+                 connect_timeout_s: float = 10.0,
+                 enable_directory: bool = True):
         if not replica_urls:
             raise ValueError("router needs at least one replica url")
         self.replicas = [ReplicaState(u) for u in replica_urls]
         self.host = host
         self.port = port
         self.prefix_len = prefix_len
+        # False reverts routing to pure hash stickiness (A/B baseline)
+        self.enable_directory = enable_directory
         self.scrape_interval_s = scrape_interval_s
         self.drain_deadline_s = drain_deadline_s
         self.connect_timeout_s = connect_timeout_s
@@ -105,7 +140,7 @@ class Router:
         self._m_routed = self.obs.counter(
             "ptpu_router_requests_total",
             "Requests proxied, by replica and route kind",
-            labelnames=("replica", "kind"))     # kind=primary|fallback
+            labelnames=("replica", "kind"))  # kind=primary|directory|fallback
         self._m_sheds = self.obs.counter(
             "ptpu_router_sheds_total",
             "Requests the router itself bounced (503)",
@@ -124,6 +159,14 @@ class Router:
             "ptpu_router_inflight", "Streams currently being proxied")
         self._m_draining = self.obs.gauge(
             "ptpu_router_draining", "1 while the router drains")
+        self._m_dir_hits = self.obs.counter(
+            "ptpu_router_directory_hits_total",
+            "Requests routed to a replica the prefix directory "
+            "identified as holding a warm matching prefix")
+        self._m_replica_prefixes = self.obs.gauge(
+            "ptpu_router_replica_prefixes",
+            "Warm prefixes the replica advertises on /kvprefixes",
+            labelnames=("replica",))
 
         self._server: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
@@ -145,6 +188,7 @@ class Router:
         ready = False
         reason = ""
         vals = {}
+        prefixes: Dict[Tuple[int, str], str] = {}
         try:
             conn = HTTPConnection(r.host, r.port,
                                   timeout=self.connect_timeout_s)
@@ -157,6 +201,20 @@ class Router:
                 conn.request("GET", "/metrics")
                 resp = conn.getresponse()
                 text = resp.read().decode("utf-8", "replace")
+                # fleet prefix directory: tolerate replicas without the
+                # endpoint (404 / bad JSON -> empty advertisement, the
+                # scrape itself still counts as healthy)
+                conn.request("GET", "/kvprefixes")
+                presp = conn.getresponse()
+                pbody = presp.read()
+                if presp.status == 200:
+                    try:
+                        for row in json.loads(pbody).get("prefixes", []):
+                            prefixes[(int(row["len"]),
+                                      str(row["digest"]))] = \
+                                str(row.get("tier", "device"))
+                    except (ValueError, KeyError, TypeError):
+                        prefixes = {}
             finally:
                 conn.close()
             vals = parse_prometheus_values(text)
@@ -166,6 +224,7 @@ class Router:
         with self._lock:
             r.ready = ready
             r.reason = reason
+            r.prefixes = prefixes
             if vals:
                 r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
                 r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
@@ -174,6 +233,8 @@ class Router:
         self._m_replica_ready.labels(replica=r.url).set(1.0 if ready else 0.0)
         self._m_replica_hit.labels(replica=r.url).set(hit_rate)
         self._m_replica_depth.labels(replica=r.url).set(queue_depth)
+        self._m_replica_prefixes.labels(replica=r.url).set(
+            float(len(prefixes)))
 
     def scrape_now(self) -> None:
         """One synchronous pass over every replica (startup, tests)."""
@@ -185,23 +246,62 @@ class Router:
             self.scrape_now()
 
     # -- routing policy ---------------------------------------------------
-    def plan_route(self, prompt: Sequence[int]) -> List[ReplicaState]:
-        """Candidate replicas in try-order: the sticky prefix-hash
-        primary first (even when it looks not-ready the scrape may be
-        stale — a 503 there falls through), then every OTHER ready
-        replica ranked best-fallback-first: highest scraped hit rate,
-        then shortest queue."""
+    def _directory_best(self, prompt: Sequence[int],
+                        snapshot: dict) -> Optional[ReplicaState]:
+        """The ready replica advertising the LONGEST prefix of `prompt`
+        at the HOTTEST tier, or None when the fleet directory has no
+        match. Digests are memoized per length: one crc32 per distinct
+        advertised prefix length, not per (replica, row)."""
+        best: Optional[ReplicaState] = None
+        best_score = (-1, -1)
+        memo: Dict[int, str] = {}
+        for r in self.replicas:
+            ready, _, _, prefixes = snapshot[r]
+            if not ready:
+                continue
+            for (ln, dg), tier in prefixes.items():
+                score = (ln, _TIER_RANK.get(tier, -1))
+                if ln > len(prompt) or score <= best_score:
+                    continue
+                if ln not in memo:
+                    memo[ln] = prefix_digest(prompt[:ln])
+                if memo[ln] == dg:
+                    best, best_score = r, score
+        return best
+
+    def _plan(self, prompt: Sequence[int]
+              ) -> Tuple[List[ReplicaState], Optional[ReplicaState]]:
+        """(candidates in try-order, directory pick or None). Base
+        order: the sticky prefix-hash primary first (even when it looks
+        not-ready the scrape may be stale — a 503 there falls through),
+        then every OTHER ready replica ranked best-fallback-first:
+        highest scraped hit rate, then shortest queue. When the fleet
+        prefix directory knows a ready replica holding a warm prefix of
+        this prompt, that replica is promoted to the front — warm KV
+        beats where the hash says the prefix should live."""
         primary = self.replicas[prefix_shard(prompt, len(self.replicas),
                                              self.prefix_len)]
         with self._lock:    # one consistent snapshot to rank against
-            stats = {r: (r.ready, r.hit_rate, r.queue_depth)
+            stats = {r: (r.ready, r.hit_rate, r.queue_depth,
+                         dict(r.prefixes))
                      for r in self.replicas}
+        dir_pick = (self._directory_best(prompt, stats)
+                    if self.enable_directory else None)
         fallbacks = sorted(
             (r for r in self.replicas if r is not primary and stats[r][0]),
             key=lambda r: (-stats[r][1], stats[r][2]))
         if stats[primary][0]:
-            return [primary] + fallbacks
-        return fallbacks + [primary]    # last-ditch: maybe stale scrape
+            order = [primary] + fallbacks
+        else:
+            order = fallbacks + [primary]   # last-ditch: maybe stale scrape
+        if dir_pick is not None and dir_pick is not order[0]:
+            order.remove(dir_pick)
+            order.insert(0, dir_pick)
+        return order, dir_pick
+
+    def plan_route(self, prompt: Sequence[int]) -> List[ReplicaState]:
+        """Candidate replicas in try-order (see _plan)."""
+        return self._plan(prompt)[0]
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Router":
@@ -332,13 +432,13 @@ class Router:
             prompt = json.loads(raw or b"{}").get("prompt") or []
         except (ValueError, json.JSONDecodeError):
             raw, prompt = b"{}", []
-        candidates = self.plan_route(prompt)
+        candidates, dir_pick = self._plan(prompt)
         if not candidates:
             self._shed(h, "no_replica")
             return
         self._track_inflight(+1)
         try:
-            self._proxy(h, raw, prompt, candidates)
+            self._proxy(h, raw, prompt, candidates, dir_pick)
         finally:
             self._track_inflight(-1)
 
@@ -354,10 +454,15 @@ class Router:
 
     def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
                prompt: Sequence[int],
-               candidates: List[ReplicaState]) -> None:
+               candidates: List[ReplicaState],
+               dir_pick: Optional[ReplicaState] = None) -> None:
         """Try candidates in order; a refused connection or a 503 shed
         moves to the next. The first streamable response is relayed
-        byte-for-byte (SSE frames pass through untouched)."""
+        byte-for-byte (SSE frames pass through untouched). The served
+        replica's route kind: "primary" when it is the hash-sticky
+        pick (the directory agreeing with the hash stays "primary" so
+        stickiness verdicts survive), "directory" when the fleet
+        prefix directory OVERRODE the hash, "fallback" otherwise."""
         sticky = self.replicas[prefix_shard(prompt, len(self.replicas),
                                             self.prefix_len)]
         last_resp: Optional[Tuple[int, bytes]] = None
@@ -378,7 +483,14 @@ class Router:
                 last_resp = (503, resp.read())
                 conn.close()
                 continue
-            kind = "primary" if r is sticky else "fallback"
+            if r is sticky:
+                kind = "primary"
+            elif dir_pick is not None and r is dir_pick:
+                kind = "directory"
+            else:
+                kind = "fallback"
+            if dir_pick is not None and r is dir_pick:
+                self._m_dir_hits.inc()
             self._m_routed.labels(replica=r.url, kind=kind).inc()
             self._relay(h, resp)
             conn.close()
@@ -430,11 +542,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--prefix-len", type=int, default=32)
     p.add_argument("--scrape-interval-s", type=float, default=0.5)
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
+    p.add_argument("--no-prefix-directory", action="store_true",
+                   help="route on hash stickiness only; ignore the "
+                        "scraped /kvprefixes fleet directory")
     a = p.parse_args(argv)
     router = Router(a.replica, host=a.host, port=a.port,
                     prefix_len=a.prefix_len,
                     scrape_interval_s=a.scrape_interval_s,
-                    drain_deadline_s=a.drain_deadline_s)
+                    drain_deadline_s=a.drain_deadline_s,
+                    enable_directory=not a.no_prefix_directory)
     router.start().install_signals()
     code = router.wait()
     router.stop()

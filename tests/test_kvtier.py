@@ -1,0 +1,269 @@
+"""Tiered KV subsystem tests (engine/kvtier.py).
+
+The tentpole guarantees under test:
+
+- THE TIER IS FAITHFUL: an fp round-trip through the host tier is
+  bit-exact; the int8 tier is exact to within one quantization step
+  (scale / 127 per element, quant/int8_compute.py's documented bound).
+- DEMOTION IS SAFE: demoting a live shared sequence copies KV out
+  without touching refcounts, and a request cancelled between revival
+  staging and the flush deregisters its index entries — the tier copy
+  stays revivable.
+- REVIVAL IS INVISIBLE: preempt -> demote -> revive reproduces the
+  roomy-pool output exactly, the jit cache stays at ONE compiled step,
+  and the fp warm path saves prefill compute.
+- THE FLEET AGREES: router.prefix_digest and kvtier.prefix_digest are
+  the same function (replica advertisement must match router lookup),
+  and plan_route prefers the replica holding the longest warm prefix
+  at the hottest tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.engine import HostKVTier, PagedKVCache, ServeEngine
+from paddle_tpu.engine.kvtier import prefix_digest
+from paddle_tpu.models.transformer import CausalLM
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.serve import router as router_mod
+from paddle_tpu.serve.router import Router
+
+pytestmark = pytest.mark.kvtier
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = CausalLM(vocab=VOCAB, model_dim=16, num_heads=4, num_layers=2,
+                     ffn_dim=32, dropout=0.0, max_len=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("registry", MetricsRegistry())
+    return ServeEngine(model, variables, **kw)
+
+
+def _tier(budget=1 << 20, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return HostKVTier(budget, **kw)
+
+
+def _cache(**kw):
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("registry", MetricsRegistry())
+    return PagedKVCache(**kw)
+
+
+def _layers(rng, num_layers=1, bs=4, heads=2, hd=8):
+    """One block's per-layer (k, v) payload: 512 bytes per layer."""
+    return [(rng.standard_normal((bs, heads, hd)).astype(np.float32),
+             rng.standard_normal((bs, heads, hd)).astype(np.float32))
+            for _ in range(num_layers)]
+
+
+# -- tier unit tests -------------------------------------------------------
+
+class TestHostKVTier:
+    def test_lru_byte_budget_evicts_coldest(self):
+        rng = np.random.default_rng(0)
+        tier = _tier(budget=1024)            # room for exactly 2 entries
+        tier.put((1,), _layers(rng))
+        tier.put((2,), _layers(rng))
+        assert len(tier) == 2 and tier.nbytes == 1024
+        tier.get((1,))                       # LRU touch: (2,) is coldest
+        tier.put((3,), _layers(rng))
+        assert tier.contains((1,)) and tier.contains((3,))
+        assert not tier.contains((2,))
+        assert len(tier) == 2 and tier.nbytes <= 1024
+
+    def test_oversized_block_is_refused(self):
+        rng = np.random.default_rng(1)
+        tier = _tier(budget=100)             # one block needs 512 bytes
+        assert tier.put((1,), _layers(rng)) is False
+        assert len(tier) == 0 and tier.nbytes == 0
+
+    def test_fp_roundtrip_bit_exact(self):
+        rng = np.random.default_rng(2)
+        tier = _tier()
+        layers = _layers(rng, num_layers=2)
+        tier.put((7, 8, 9), layers)
+        back = tier.get((7, 8, 9))
+        assert back is not None and len(back) == 2
+        for (k0, v0), (k1, v1) in zip(layers, back):
+            assert np.array_equal(k0, k1) and k1.dtype == k0.dtype
+            assert np.array_equal(v0, v1) and v1.dtype == v0.dtype
+
+    def test_int8_roundtrip_within_one_quant_step(self):
+        rng = np.random.default_rng(3)
+        tier = _tier(int8=True)
+        layers = _layers(rng, num_layers=2)
+        tier.put((7, 8, 9), layers)
+        back = tier.get((7, 8, 9))
+        for (k0, v0), (k1, v1) in zip(layers, back):
+            for orig, deq in ((k0, k1), (v0, v1)):
+                assert deq.dtype == orig.dtype
+                bound = np.max(np.abs(orig)) / 127 + 1e-7
+                assert np.max(np.abs(deq - orig)) <= bound
+        # and int8 storage really is ~half the fp footprint
+        fp = _tier()
+        fp.put((7, 8, 9), layers)
+        assert tier.nbytes < 0.6 * fp.nbytes
+
+
+# -- cache-level demotion / revival bookkeeping ----------------------------
+
+class TestCacheTierWalk:
+    def test_demote_live_shared_sequence_leaves_refs_intact(self):
+        tier = _tier()
+        c = _cache(host_tier=tier)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.alloc_sequence(2, toks)            # full hit: blocks shared
+        assert c.shared_blocks == 2
+        assert c.demote_sequence(1) == 2     # preempt-path copy-out
+        assert tier.contains(tuple(toks[:4])) and tier.contains(tuple(toks))
+        assert [c.ref_count(b) for b in c.block_table(1)] == [2, 2]
+        # re-demoting is a no-op: the tier already holds both keys
+        assert c.demote_sequence(2) == 0
+        c.free_sequence(1)
+        c.free_sequence(2)
+        c.assert_quiesced()
+
+    def test_cancel_mid_revival_keeps_tier_copy_revivable(self):
+        tier = _tier()
+        c = _cache(host_tier=tier)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.demote_sequence(1)
+        c.free_sequence(1)
+        c.alloc_sequence(2, [90 + i for i in range(60)])  # churn: recycle all
+        c.free_sequence(2)
+        # device index is gone; the walk must come back from the tier
+        c.alloc_sequence(3, toks)
+        assert c.tier_revivals == 2
+        assert len(c._pending_host_loads) == 2
+        c.free_sequence(3)                   # dies before the flush
+        c.assert_quiesced()                  # pending loads cancelled
+        # the tier copy survived the cancellation: revive again
+        assert c.alloc_sequence(4, toks) == 7
+        assert c.tier_revivals == 4
+        loads = c.drain_host_loads()
+        assert sorted(b for b, _ in loads) == sorted(c.block_table(4))
+        c.free_sequence(4)
+        c.assert_quiesced()
+
+    def test_stats_carry_tier_series(self):
+        tier = _tier()
+        c = _cache(host_tier=tier)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.demote_sequence(1)
+        c.free_sequence(1)
+        s = c.stats()
+        assert s["tier_entries"] == 2 and s["tier_bytes"] > 0
+        assert s["tier_int8"] is False and s["tier_revivals"] == 0
+
+
+# -- engine-level: preempt -> demote -> revive is invisible ----------------
+
+TAILS = [[21, 22, 23, 24], [31, 32, 33, 34], [41, 42, 43, 44]]
+
+
+def test_preempt_demote_revive_identical_to_roomy(model_and_vars):
+    """A tight pool preempts; with a host tier attached the victim's
+    committed blocks demote and re-admission revives them by DMA. The
+    output must equal the roomy (never-preempted) run token for token,
+    and the whole drain stays on the ONE compiled step."""
+    model, variables = model_and_vars
+    prompts = [[7, 3, 7, 3] + t for t in TAILS]
+    roomy = _engine(model, variables, max_batch_size=3)
+    want = roomy.generate(prompts, max_new_tokens=12)
+    tight = _engine(model, variables, max_batch_size=3, num_blocks=9,
+                    host_tier_bytes=1 << 20)
+    got = tight.generate(prompts, max_new_tokens=12)
+    assert got == want
+    assert sum(r.preemptions for r in tight.finished.values()) > 0
+    demoted = tight.obs.get("ptpu_kv_tier_demoted_blocks_total")
+    assert demoted.labels(reason="preempt").value > 0
+    assert tight._step_fn._cache_size() == 1
+    tight.cache.assert_quiesced()
+
+
+def test_int8_tier_revives_and_completes(model_and_vars):
+    """cold -> churn (demote) -> warm on an int8 tier: the warm run
+    revives quantized KV and must still complete every request (tokens
+    may differ from fp within quantization noise — completion and
+    compile count are the gates)."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, num_blocks=10,
+                  host_tier_bytes=1 << 20, kv_tier_int8=True)
+    system = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]
+    cold = eng.generate([system + TAILS[0]], max_new_tokens=6)
+    for i in range(2):                       # churn: recycle the pool
+        eng.generate([[50 + i] * 16], max_new_tokens=4)
+    warm = eng.generate([system + TAILS[0]], max_new_tokens=6)
+    assert len(warm[0]) == len(cold[0]) > 0
+    assert eng.obs.get("ptpu_kv_tier_revived_blocks_total").value > 0
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+# -- fleet prefix directory ------------------------------------------------
+
+def test_prefix_digest_matches_router_side():
+    """The replica advertises kvtier.prefix_digest; the router looks up
+    router.prefix_digest. They MUST be the same function — and stable
+    across runs (a directory of salted hashes would never match)."""
+    rng = np.random.default_rng(5)
+    for _ in range(5):
+        toks = rng.integers(0, 2 ** 31, rng.integers(1, 40)).tolist()
+        assert prefix_digest(toks) == router_mod.prefix_digest(toks)
+    assert prefix_digest([]) == "00000000"   # crc32(b"") pin
+
+
+def test_router_prefers_longest_then_hottest():
+    urls = [f"http://127.0.0.1:{9000 + i}" for i in range(3)]
+    router = Router(urls, enable_directory=True)
+    a, b, _ = router.replicas
+    for r in router.replicas:
+        r.ready = True
+    prompt = list(range(12))
+    primary = router.replicas[router_mod.prefix_shard(prompt, 3)]
+    d4 = prefix_digest(prompt[:4])
+    d8 = prefix_digest(prompt[:8])
+    # longest match wins regardless of tier ...
+    a.prefixes = {(4, d4): "device"}
+    b.prefixes = {(8, d8): "host"}
+    assert router.plan_route(prompt)[0] is b
+    # ... and equal lengths split on tier heat (device beats host)
+    a.prefixes = {(8, d8): "device"}
+    assert router.plan_route(prompt)[0] is a
+    # an advertised prefix LONGER than the prompt never matches, and
+    # with no match at all the sticky hash primary leads
+    a.prefixes = {(16, prefix_digest(list(range(16)))): "device"}
+    b.prefixes = {}
+    assert router.plan_route(prompt)[0] is primary
+    # A/B baseline: directory disabled ignores a perfect advertisement
+    router.enable_directory = False
+    b.prefixes = {(8, d8): "device"}
+    assert router.plan_route(prompt)[0] is primary
+    # A/B baseline: directory disabled ignores a perfect advertisement
+    router.enable_directory = False
+    b.prefixes = {(8, d8): "device"}
+    assert router.plan_route(prompt)[0] is primary

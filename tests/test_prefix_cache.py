@@ -324,6 +324,27 @@ def test_chunked_prefill_interleaves_decode(model_and_vars, capsys):
     assert any(prefills[0] < d < prefills[-1] for d in decodes)
 
 
+def test_host_tier_revival_identical_and_saves_prefill(model_and_vars):
+    """cold -> churn (cached-free blocks demote to the host tier) ->
+    warm: the warm run revives the prompt's KV from the host tier by
+    DMA instead of re-prefilling. Revival must be invisible — exactly
+    the cold tokens — and the warm prefill compute must shrink."""
+    model, variables = model_and_vars
+    from paddle_tpu.obs.metrics import MetricsRegistry
+    eng = _engine(model, variables, num_blocks=10,
+                  host_tier_bytes=1 << 20, registry=MetricsRegistry())
+    prompt = SYSTEM + TAILS[0]                   # 16 tokens, 4 full blocks
+    cold = eng.generate([prompt], max_new_tokens=6)
+    for i in range(2):                           # churn: recycle the pool
+        eng.generate([[50 + i] * 16], max_new_tokens=4)
+    before = eng.prefill_tokens_computed
+    warm = eng.generate([prompt], max_new_tokens=6)
+    assert warm == cold                    # revival never changes tokens
+    assert eng.cache.stats()["tier_revivals"] >= 3
+    assert eng.prefill_tokens_computed - before < len(prompt)
+    eng.cache.assert_quiesced()
+
+
 def test_serve_events_carry_cache_stats(model_and_vars, capsys):
     model, variables = model_and_vars
     eng = _engine(model, variables)

@@ -1,11 +1,11 @@
 """Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
-Five scenarios, each an acceptance property of the serving stack
-(ENGINE.md / OBSERVABILITY.md). The first four run in-process on the
+Eight scenarios, each an acceptance property of the serving stack
+(ENGINE.md / OBSERVABILITY.md). The in-process scenarios run on the
 SAME model with EXACT token identity (greedy decode — the engine's
 batching/sharing/chunking invariance makes identity, not closeness,
-the bar); the fifth stands up real replica PROCESSES and drives them
-over HTTP:
+the bar); the router scenario stands up real replica PROCESSES and
+drives them over HTTP:
 
 - batch:   continuous batching must beat one-request-at-a-time decode
            on throughput (weight passes amortized over the batch).
@@ -40,6 +40,17 @@ over HTTP:
            prompt blocks: every candidate byte-identical to a solo run
            with its seed, the prompt prefilled ONCE for the group, and
            pool occupancy back to zero after a mid-flight group cancel.
+- tiered:  host-RAM KV tier (engine/kvtier.py) on a deliberately
+           undersized block pool: filler traffic recycles every
+           cached-free block — demoting the shared system prefix to
+           host RAM — and re-serving the SAME requests must revive it
+           by DMA instead of re-prefill: host-tier revived tokens > 0,
+           fewer prefill tokens than the cold pass, warm mean TTFT
+           within 1.5x of cold, compile gauge still 1, and tokens
+           byte-identical to an ample-pool no-tier reference (fp
+           tier; the int8 sub-cell is completion + revival gated —
+           its round-trip is exact only to scale/127 per element).
+           Cold/warm cells flush as measured.
 - router:  the end-to-end scale-out story (serve/). Boots replica
            subprocesses (`python -m paddle_tpu.serve.replica`) with
            identical weights and a Router over them, then gates three
@@ -67,7 +78,7 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 Exit code: 0 iff every scenario's verdict holds.
 
 Run: python tools/serve_bench.py
-     [--scenario all|batch|prefix|chunked|mixed|router]
+     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|router]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
      [--trace-out FILE]     # dump the last in-process verdict engine's
@@ -578,6 +589,159 @@ def scenario_nbest(model, variables, args):
     return ok
 
 
+# -- scenario: host-RAM KV tier — demote on recycle, revive by DMA ---------
+
+def _labelled_counter(eng, name, **labels):
+    fam = eng.obs.get(name)
+    if fam is None:
+        return 0.0
+    return fam.labels(**labels).value if labels else fam.value
+
+
+def _serve_turns_ttft(eng, prompts, new_tokens):
+    """serve_turns + per-request TTFT (ms) straight off the request
+    objects — the tier verdict compares INDIVIDUAL requests (the warm
+    revival vs the cold full prefill), which the histogram mean hides
+    behind the cheap device-hit turns."""
+    outs, ttfts = [], []
+    t0 = time.perf_counter()
+    for p in prompts:
+        r = eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()
+        outs.append(eng._generated_of(r))
+        ttfts.append((r.first_token_time - r.enqueue_time) * 1e3)
+    return outs, ttfts, time.perf_counter() - t0
+
+
+def _run_tier_cell(model, variables, args, prompts, fillers, int8):
+    """cold -> flush -> warm on ONE undersized-pool engine with the
+    host tier attached. Cold/warm cells are emitted AS MEASURED (the
+    early-flush contract); returns the numbers the verdict needs."""
+    tag = "_int8" if int8 else ""
+    eng = make_engine(model, variables, args,
+                      num_blocks=args.tier_num_blocks,
+                      max_prefill_tokens=args.chunk_tokens,
+                      host_tier_bytes=args.tier_host_bytes,
+                      kv_tier_int8=int8)
+    eng.generate([[args.vocab - 1] * len(prompts[0])],
+                 max_new_tokens=2)                  # compile untimed
+    eng.reset_stats()
+    cold_outs, cold_ttfts, cold_wall = _serve_turns_ttft(
+        eng, prompts, args.new_tokens)
+    cold_prefill = int(eng.obs.get("ptpu_serve_tokens_total")
+                       .labels(kind="prefill").value)
+    emit({"cell": f"tiered_cold{tag}", "requests": len(prompts),
+          "prompt_len": len(prompts[0]),
+          "pool_blocks": args.tier_num_blocks,
+          "wall_s": round(cold_wall, 3),
+          "first_ttft_ms": round(cold_ttfts[0], 3),
+          "mean_ttft_ms": round(np.mean(cold_ttfts), 3),
+          "prefill_tokens_computed": cold_prefill})
+    # flush: distinct full-length fillers cycle the undersized pool's
+    # FIFO free list, so every cached-free system block is recycled —
+    # and, with the tier attached, demoted to host RAM instead of lost
+    for f in fillers:
+        eng.add_request(f, max_new_tokens=args.new_tokens)
+        eng.run()
+    demoted = int(
+        _labelled_counter(eng, "ptpu_kv_tier_demoted_blocks_total",
+                          reason="evict")
+        + _labelled_counter(eng, "ptpu_kv_tier_demoted_blocks_total",
+                            reason="preempt"))
+    # isolate the warm pass's registry story (same contention-window
+    # reset the chunked/mixed cells use)
+    eng.obs.reset()
+    warm_outs, warm_ttfts, warm_wall = _serve_turns_ttft(
+        eng, prompts, args.new_tokens)
+    warm_prefill = int(eng.obs.get("ptpu_serve_tokens_total")
+                       .labels(kind="prefill").value)
+    revived_blocks = int(_labelled_counter(
+        eng, "ptpu_kv_tier_revived_blocks_total"))
+    revived_tokens = int(_labelled_counter(
+        eng, "ptpu_kv_tier_revived_tokens_total"))
+    eng.cache.assert_quiesced()
+    emit({"cell": f"tiered_warm{tag}", "requests": len(prompts),
+          "wall_s": round(warm_wall, 3),
+          "first_ttft_ms": round(warm_ttfts[0], 3),
+          "mean_ttft_ms": round(np.mean(warm_ttfts), 3),
+          "prefill_tokens_computed": warm_prefill,
+          "demoted_blocks": demoted,
+          "revived_blocks": revived_blocks,
+          "revived_tokens": revived_tokens,
+          "tier_entries": len(eng.host_tier),
+          "tier_bytes": eng.host_tier.nbytes,
+          "compiles": int(eng._step_fn._cache_size())})
+    return {"eng": eng, "cold_outs": cold_outs, "warm_outs": warm_outs,
+            "cold_ttft": cold_ttfts[0], "warm_ttft": warm_ttfts[0],
+            "cold_prefill": cold_prefill, "warm_prefill": warm_prefill,
+            "demoted": demoted, "revived_blocks": revived_blocks,
+            "revived_tokens": revived_tokens,
+            "compiles": int(eng._step_fn._cache_size())}
+
+
+def scenario_tiered(model, variables, args):
+    """Preempt/evict -> demote -> revive round trip under real serving
+    traffic: an undersized pool forces the system prefix out to the
+    host tier, and the warm pass must get it back by DMA — byte-exact
+    for the fp tier, completion + revival gated for int8."""
+    global LAST_EXPOSITION, LAST_TRACER
+    rng = np.random.default_rng(8)
+    system = rng.integers(0, args.vocab - 1, args.system_len).tolist()
+    prompts = [system + rng.integers(0, args.vocab - 1,
+                                     args.tail_len).tolist()
+               for _ in range(args.requests)]
+    flen = args.system_len + args.tail_len
+    fillers = [rng.integers(0, args.vocab - 1, flen).tolist()
+               for _ in range(args.requests)]
+
+    # identity bar: ample pool, no tier, same chunk budget
+    ref = make_engine(model, variables, args,
+                      max_prefill_tokens=args.chunk_tokens)
+    ref.generate([[args.vocab - 1] * len(prompts[0])], max_new_tokens=2)
+    ref.reset_stats()
+    ref_outs, _ = serve_turns(ref, prompts, args.new_tokens)
+
+    fp = _run_tier_cell(model, variables, args, prompts, fillers,
+                        int8=False)
+    LAST_EXPOSITION = fp["eng"].metrics_text()
+    LAST_TRACER = fp["eng"].tracer
+    fp_identical = fp["warm_outs"] == fp["cold_outs"] == ref_outs
+    # TTFT bound compares the SAME request cold vs warm: the first
+    # turn pays the full chunked prefill cold and the host-tier
+    # revival warm — revival must stay within 1.5x of it (on real
+    # contexts it is far cheaper; at toy scale demote device_gets and
+    # the DMA flush eat most of the win, so 1.5x is the bound)
+    fp_ok = bool(fp_identical
+                 and fp["demoted"] > 0
+                 and fp["revived_tokens"] > 0
+                 and fp["warm_prefill"] < fp["cold_prefill"]
+                 and fp["warm_ttft"] <= 1.5 * fp["cold_ttft"]
+                 and fp["compiles"] == 1)
+
+    q = _run_tier_cell(model, variables, args, prompts, fillers,
+                       int8=True)
+    int8_complete = bool(
+        len(q["warm_outs"]) == len(prompts)
+        and all(len(w) == len(c) > 0
+                for w, c in zip(q["warm_outs"], q["cold_outs"])))
+    int8_ok = bool(int8_complete and q["revived_tokens"] > 0
+                   and q["compiles"] == 1)
+
+    ok = bool(fp_ok and int8_ok)
+    emit({"cell": "tiered_verdict", "ok": ok,
+          "fp_ok": fp_ok, "int8_ok": int8_ok,
+          "tokens_identical": bool(fp_identical),
+          "demoted_blocks": fp["demoted"],
+          "revived_tokens": fp["revived_tokens"],
+          "prefill_tokens_saved": fp["cold_prefill"] - fp["warm_prefill"],
+          "warm_ttft_ratio": round(fp["warm_ttft"]
+                                   / max(fp["cold_ttft"], 1e-9), 3),
+          "int8_complete": int8_complete,
+          "int8_tokens_identical":
+              bool(q["warm_outs"] == ref_outs)})   # informational only
+    return ok
+
+
 # -- scenario: router — multi-replica scale-out over real processes --------
 
 # the replica CLI's default model (vocab 61, dim 16) boots in seconds;
@@ -922,7 +1086,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
-                             "mixed", "spec", "nbest", "router"])
+                             "mixed", "spec", "nbest", "tiered",
+                             "router"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -939,6 +1104,13 @@ def main():
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft window for the spec scenario (tokens "
                     "proposed per decode step by the n-gram drafter)")
+    # tiered scenario (host-RAM KV tier on an undersized pool)
+    ap.add_argument("--tier-num-blocks", type=int, default=20,
+                    help="block pool size for the tiered scenario — "
+                    "small enough that filler traffic recycles every "
+                    "cached-free block (demotion pressure)")
+    ap.add_argument("--tier-host-bytes", type=int, default=8 << 20,
+                    help="host-tier byte budget for the tiered scenario")
     # router scenario (replica fleet + scraped verdicts)
     ap.add_argument("--router-system-len", type=int, default=16,
                     help="shared system-prompt length per prefix group "
@@ -964,7 +1136,7 @@ def main():
     scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
                  "chunked": scenario_chunked, "mixed": scenario_mixed,
                  "spec": scenario_spec, "nbest": scenario_nbest,
-                 "router": scenario_router}
+                 "tiered": scenario_tiered, "router": scenario_router}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
