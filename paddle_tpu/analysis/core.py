@@ -51,6 +51,7 @@ RULES: Dict[str, str] = {
     "TS003": "metric kind/label-set disagrees with OBSERVABILITY.md",
     "TS004": "unbounded label cardinality (dynamic value passed to .labels())",
     "TS005": "emit_event stream not in the documented stream set",
+    "TS006": "undocumented /debug or /trace introspection route",
     "EH001": "bare assert in library (non-test) code — stripped under -O",
     "EH002": "daemon-thread loop swallows exceptions without logging",
     "EH003": "log.error in except handler without exc_info",

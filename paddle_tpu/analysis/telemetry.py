@@ -1,4 +1,4 @@
-"""Telemetry-schema pass (rules TS001-TS005).
+"""Telemetry-schema pass (rules TS001-TS006).
 
 OBSERVABILITY.md's "Metric inventory" table is the contract between the
 code and every dashboard/alert built on the scrape; this pass keeps the
@@ -18,6 +18,11 @@ two sides honest in both directions:
   but *constructed* strings are always request-derived.
 * TS005 — an ``emit_event``-family call whose stream literal is not one
   of the documented streams (serve / resilience / obs).
+* TS006 — a string literal naming a ``/debug`` or ``/trace`` route that
+  OBSERVABILITY.md's "Introspection routes" section doesn't list: the
+  JSON debug surface is closed-world, same as metric series and event
+  streams. A documented route ending in ``/`` covers its subpaths
+  (``/trace/`` covers ``/trace/<id>``).
 
 The doc parser understands the inventory's two compaction idioms:
 ```a` / `b``` rows (shared type/labels) and brace expansion
@@ -40,6 +45,8 @@ _EVENT_FNS = {"emit_event"}
 #: wrappers in utils/log.py that pin the stream themselves
 _EVENT_WRAPPERS = {"serve_event": "serve", "resilience_event": "resilience",
                    "obs_event": "obs"}
+#: route namespaces TS006 treats as closed-world
+_ROUTE_PREFIXES = ("/debug", "/trace")
 
 
 class DocSeries:
@@ -105,6 +112,36 @@ def parse_inventory(doc_path: str,
     return series, rel
 
 
+def parse_routes(doc_path: str) -> Set[str]:
+    """Parse the "Introspection routes" section -> documented routes."""
+    routes: Set[str] = set()
+    try:
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return routes
+    in_routes = False
+    for line in lines:
+        if line.startswith("## "):
+            in_routes = line.lower().startswith("## introspection routes")
+            continue
+        if not in_routes:
+            continue
+        for span in _CODE_SPAN_RE.findall(line):
+            if span.startswith("/"):
+                routes.add(span)
+    return routes
+
+
+def _route_documented(value: str, routes: Set[str]) -> bool:
+    for doc in routes:
+        if value.rstrip("/") == doc.rstrip("/"):
+            return True
+        if doc.endswith("/") and value.startswith(doc):
+            return True  # `/trace/` covers `/trace/<anything>`
+    return False
+
+
 def _registration_labels(call: ast.Call) -> Optional[Tuple[str, ...]]:
     """Extract the labelnames tuple from a registration call, if static."""
     node: Optional[ast.AST] = None
@@ -148,14 +185,17 @@ def run(files: Sequence[SourceFile], doc_path: str,
     if doc_path:
         doc, doc_rel = parse_inventory(doc_path, root)
 
+    routes = parse_routes(doc_path) if doc_path else None
     registered: Set[str] = set()
     for sf in files:
         for node in ast.walk(sf.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            _check_registration(sf, node, doc, doc_path, registered, findings)
-            _check_labels_call(sf, node, findings)
-            _check_event_stream(sf, node, findings)
+            if isinstance(node, ast.Call):
+                _check_registration(sf, node, doc, doc_path, registered,
+                                    findings)
+                _check_labels_call(sf, node, findings)
+                _check_event_stream(sf, node, findings)
+            elif routes is not None:
+                _check_route_constant(sf, node, routes, findings)
 
     # TS002: doc rows nothing registers — only meaningful on a run that
     # actually covers the instrumented packages.
@@ -239,3 +279,22 @@ def _check_event_stream(sf, call, findings) -> None:
             call.lineno, "TS005",
             f"emit_event stream '{expr_text(first)}' is not a string literal — "
             "streams must be statically checkable"))
+
+
+def _check_route_constant(sf, node, routes, findings) -> None:
+    """TS006: the /debug and /trace JSON surfaces are closed-world —
+    a route string nothing in OBSERVABILITY.md's "Introspection routes"
+    section lists is a dashboard-invisible endpoint (or a typo'd
+    client). f-string/concat constants are covered too: their static
+    prefix (`"/trace/" + tid`) is itself a Constant node."""
+    if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+        return
+    value = node.value
+    if not value.startswith(_ROUTE_PREFIXES):
+        return
+    if _route_documented(value, routes):
+        return
+    findings.append(sf.finding(
+        node.lineno, "TS006",
+        f"introspection route '{value}' is not documented in "
+        "OBSERVABILITY.md's \"Introspection routes\" section"))

@@ -587,6 +587,51 @@ class ServeEngine:
                        for ln, dg in self.host_tier.advertised(limit))
         return out
 
+    @staticmethod
+    def _request_summary(req: Request) -> dict:
+        return {
+            "req_id": req.req_id,
+            "state": req.state,
+            "prompt_len": len(req.prompt),
+            "generated": req.num_generated,
+            "prefill_pos": req.prefill_pos,
+            "cached_tokens": req.cached_tokens,
+            "preemptions": req.preemptions,
+            "deadline": None if req.deadline == float("inf")
+            else req.deadline,
+            "n_candidates": req.n_candidates,
+        }
+
+    def debug_state(self) -> dict:
+        """Introspection snapshot for /debug and the flight recorder:
+        the wait queue and running set as request summaries, block-pool
+        occupancy, and the host-tier LRU summary. Engine-loop thread
+        for a CONSISTENT view (the serve front-end refreshes it between
+        steps); the flight recorder may also call it best-effort from a
+        watchdog thread when the engine loop is wedged — reads only,
+        never mutates, so a torn read is the worst case."""
+        pool = {
+            "num_blocks": self.cache.num_blocks,
+            "block_size": self.cache.block_size,
+            "free_blocks": self.cache.free_blocks,
+            "used_blocks": self.cache.used_blocks,
+            "shared_blocks": self.cache.shared_blocks,
+            "occupancy": round(self.cache.occupancy(), 4),
+        }
+        out = {
+            "steps": self.steps,
+            "queue_depth": self.scheduler.queue_depth,
+            "waiting": [self._request_summary(r)
+                        for r in self.scheduler.waiting],
+            "running": [self._request_summary(r)
+                        for r in self.scheduler.running],
+            "pool": pool,
+            "cache": self.cache.stats(),
+        }
+        if self.host_tier is not None:
+            out["host_tier"] = self.host_tier.stats()
+        return out
+
     def _step_mixed(self, rows: List[StepRow]
                     ) -> "tuple[int, int, int, int]":
         """Pack the plan's rows — decode rows AND prefill chunks — into

@@ -13,6 +13,14 @@ The layer every serving subsystem reports through:
 - `slo` — SLOMonitor: objectives over the live registry, multi-window
   burn rates, `/slo` verdict — what admission control and the replica
   router consume.
+- `fleetmetrics` — federation of per-replica expositions into one
+  fleet-wide scrape body (`/metrics/fleet` on the router): counters
+  sum, log-bucketed histograms merge exactly, gauges re-label per
+  replica.
+- `flightrec` — FlightRecorder: bounded ring of recent serve /
+  resilience events plus an engine state snapshot, dumped as a
+  postmortem JSON bundle on watchdog stall, SLO burn, drain timeout,
+  or engine-loop crash.
 
 ServeEngine / Scheduler / PagedKVCache and the resilience runtime
 record into `default_registry()` unless constructed with an explicit
@@ -29,14 +37,27 @@ from paddle_tpu.obs.metrics import (
     default_registry,
     log_buckets,
 )
-from paddle_tpu.obs.tracing import RequestTracer, merged_chrome_trace
+from paddle_tpu.obs.tracing import (
+    RequestTracer,
+    merged_chrome_trace,
+    stitch_fragments,
+)
 from paddle_tpu.obs.http import MetricsServer, json_route, obs_response
 from paddle_tpu.obs.slo import SLOMonitor, SLOObjective, default_objectives
+from paddle_tpu.obs.fleetmetrics import (
+    counter_totals,
+    federate,
+    histogram_buckets,
+    parse_exposition,
+)
+from paddle_tpu.obs.flightrec import FlightRecorder
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "Snapshotter", "default_registry", "log_buckets",
-    "RequestTracer", "merged_chrome_trace", "MetricsServer",
-    "json_route", "obs_response",
+    "RequestTracer", "merged_chrome_trace", "stitch_fragments",
+    "MetricsServer", "json_route", "obs_response",
     "SLOMonitor", "SLOObjective", "default_objectives",
+    "counter_totals", "federate", "histogram_buckets", "parse_exposition",
+    "FlightRecorder",
 ]

@@ -15,7 +15,11 @@ rule) serving the observability surface every replica exposes:
   gate on THIS one; a replica failing /readyz but passing /healthz is
   cold or draining, not dead;
 - any extra mounted route (e.g. `/slo` -> the SLOMonitor verdict JSON,
-  obs/slo.py) via `routes={path: callable -> (status, ctype, body)}`.
+  obs/slo.py) via `routes={path: callable -> (status, ctype, body)}`;
+- parameterised routes (e.g. `/trace/<id>`) via
+  `prefix_routes={prefix: callable(path) -> (status, ctype, body)}` —
+  exact routes win, then the longest matching prefix gets the FULL
+  path so it can parse the tail itself.
 
 `port=0` binds an ephemeral port (read it back from `.port` — what
 tests use); the server runs on a daemon thread so it can never hold a
@@ -58,13 +62,19 @@ def json_route(fn: Callable[[], dict]) -> Callable[[], Response]:
 
 def obs_response(path: str, registry: MetricsRegistry,
                  readiness: Optional[Readiness] = None,
-                 routes: Optional[Dict[str, Callable[[], Response]]] = None
+                 routes: Optional[Dict[str, Callable[[], Response]]] = None,
+                 prefix_routes: Optional[
+                     Dict[str, Callable[[str], Response]]] = None
                  ) -> Optional[Response]:
     """Answer one observability GET; None when the path is not ours
     (the caller 404s or falls through to its own API)."""
     path = path.split("?")[0]
     if routes and path in routes:
         return routes[path]()
+    if prefix_routes:
+        for pfx in sorted(prefix_routes, key=len, reverse=True):
+            if path.startswith(pfx):
+                return prefix_routes[pfx](path)
     if path == "/metrics":
         return 200, CONTENT_TYPE, registry.render_prometheus().encode()
     if path == "/healthz":
@@ -87,13 +97,16 @@ class MetricsServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  readiness: Optional[Readiness] = None,
-                 routes: Optional[Dict[str, Callable[[], Response]]] = None):
+                 routes: Optional[Dict[str, Callable[[], Response]]] = None,
+                 prefix_routes: Optional[
+                     Dict[str, Callable[[str], Response]]] = None):
         self.registry = registry if registry is not None \
             else default_registry()
         self.host = host
         self.port = port
         self.readiness = readiness
         self.routes = dict(routes or {})
+        self.prefix_routes = dict(prefix_routes or {})
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -109,7 +122,8 @@ class MetricsServer:
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):                           # noqa: N802 (stdlib)
                 resp = obs_response(self.path, outer.registry,
-                                    outer.readiness, outer.routes)
+                                    outer.readiness, outer.routes,
+                                    outer.prefix_routes)
                 if resp is None:
                     resp = (404, "text/plain", b"not found\n")
                 status, ctype, body = resp

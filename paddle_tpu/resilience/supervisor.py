@@ -103,11 +103,7 @@ class RunSupervisor:
                 self._old_handlers[sig] = signal.signal(sig, self._on_signal)
             except ValueError:
                 pass  # not the main thread: poll-only supervisor
-        if self.watchdog_timeout_s:
-            self._watch_stop.clear()
-            self._watch_thread = threading.Thread(
-                target=self._watchdog, daemon=True, name="ptpu-watchdog")
-            self._watch_thread.start()
+        self.start_watchdog()
         return self
 
     def uninstall(self) -> None:
@@ -117,6 +113,20 @@ class RunSupervisor:
             except ValueError:
                 pass
         self._old_handlers.clear()
+        self.stop_watchdog()
+
+    def start_watchdog(self) -> None:
+        """Start ONLY the hung-step watchdog (no signal handlers) —
+        what the serve front-end uses: its own drain handler owns
+        SIGTERM, but it still wants stall detection + the on_hang
+        postmortem hook around engine steps."""
+        if self.watchdog_timeout_s and self._watch_thread is None:
+            self._watch_stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watchdog, daemon=True, name="ptpu-watchdog")
+            self._watch_thread.start()
+
+    def stop_watchdog(self) -> None:
         self._watch_stop.set()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5)
@@ -182,7 +192,15 @@ class RunSupervisor:
                                  elapsed_s=round(elapsed, 3),
                                  timeout_s=self.watchdog_timeout_s)
                 if self.on_hang is not None:
-                    self.on_hang(step, elapsed)
+                    # on_hang is the postmortem path (the serve loop
+                    # mounts the flight recorder here) — it must never
+                    # kill the watchdog, which is the only observer of
+                    # a wedged step
+                    try:
+                        self.on_hang(step, elapsed)
+                    except Exception as e:
+                        resilience_event("hang_hook_error", step=step,
+                                         error=repr(e))
 
 
 class _StepWatch:

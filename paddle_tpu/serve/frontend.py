@@ -28,6 +28,22 @@ One process, one port, two planes:
   is a labeled `ptpu_serve_sheds_total{reason=...}` increment, so
   overload is observable from the same scrape that caused it.
 
+INTROSPECTION + POSTMORTEM (OBSERVABILITY.md §introspection). Each
+request carries a fleet trace id (the router's `x-ptpu-trace` header,
+minted locally when absent) that tags its tracer spans and rides the
+done frame back to the client; `/trace/<id>` serves that request's
+span fragment for the router's cross-process stitcher. `/debug`
+exposes the engine-loop-refreshed scheduler/KV-pool/tier snapshot
+(handler threads never touch the engine), and a FlightRecorder
+(obs/flightrec.py) keeps the recent serve/resilience event ring,
+dumping a postmortem bundle on watchdog stall (`watchdog_s` arms a
+RunSupervisor watchdog around engine steps), SLO burn onset, drain
+deadline, or an engine-loop crash — `/debug/flightrec` shows the
+latest bundle. `/debug/stall/<s>` (armed only with `enable_chaos`)
+wedges the next engine step on purpose: the serve_bench fleet-obs
+cell uses it to prove a real stall produces a bundle naming the
+stuck request.
+
 THREADING. The engine is single-threaded by design (compiled steps,
 host-side allocator bookkeeping). All engine mutation happens on ONE
 loop thread; HTTP handler threads only enqueue work (submissions,
@@ -54,19 +70,22 @@ import signal
 import socket
 import threading
 import time
+import uuid
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 
 from paddle_tpu.engine.engine import ServeEngine
 from paddle_tpu.engine.scheduler import Request
+from paddle_tpu.obs.flightrec import FlightRecorder
 from paddle_tpu.obs.http import json_route, obs_response
 from paddle_tpu.obs.slo import SLOMonitor
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
+from paddle_tpu.resilience.supervisor import RunSupervisor
 from paddle_tpu.serve.sse import DONE_SENTINEL, sse_event
 from paddle_tpu.utils.log import serve_event
 
-_DIR_INTERVAL_S = 0.25   # /kvprefixes snapshot refresh cadence
+_DIR_INTERVAL_S = 0.25   # default /kvprefixes + /debug refresh cadence
 
 
 class _Stream:
@@ -100,7 +119,12 @@ class ServeFrontend:
                  drain_deadline_s: float = 30.0,
                  default_max_new_tokens: int = 64,
                  default_deadline_ms: Optional[float] = None,
-                 warmup: bool = True):
+                 warmup: bool = True,
+                 dir_interval_s: float = _DIR_INTERVAL_S,
+                 watchdog_s: float = 0.0,
+                 flightrec_out: Optional[str] = None,
+                 flightrec_capacity: int = 256,
+                 enable_chaos: bool = False):
         self.engine = engine
         self.host = host
         self.port = port
@@ -111,7 +135,9 @@ class ServeFrontend:
         self.drain_deadline_s = drain_deadline_s
         self.default_max_new_tokens = default_max_new_tokens
         self.default_deadline_ms = default_deadline_ms
+        self.dir_interval_s = dir_interval_s
         self._warmup = warmup
+        self._enable_chaos = enable_chaos
         self.exit_code: Optional[int] = None
 
         self._server: Optional[ThreadingHTTPServer] = None
@@ -130,10 +156,30 @@ class ServeFrontend:
         # threads serve the snapshot (never touch the engine)
         self._directory: List[dict] = []     # guarded-by: self._lock
         self._dir_next = 0.0                 # engine-loop thread only
+        # /debug snapshot: refreshed on the engine loop at the same
+        # cadence as the directory; handler threads serve the copy
+        self._debug_snapshot: dict = {}      # guarded-by: self._lock
+        self._stall_s = 0.0                  # guarded-by: self._lock
         self._draining = False
         self._drain_started = 0.0
+        self._drain_dumped = False           # engine-loop thread only
+        self._burn_prev = False              # engine-loop thread only
         self._stop_requested = False
         self._warm = False
+
+        # postmortem plane: the flight recorder taps the process event
+        # streams (ring of recent serve/resilience records) and, when
+        # watchdog_s > 0, a RunSupervisor watchdog wraps engine steps
+        # so a wedged step dumps a bundle while the stall is live
+        self.flightrec = FlightRecorder(
+            capacity=flightrec_capacity,
+            snapshot_fn=self._flight_snapshot,
+            out_dir=flightrec_out,
+            registry=engine.obs)
+        self._sup: Optional[RunSupervisor] = None
+        if watchdog_s > 0:
+            self._sup = RunSupervisor(
+                watchdog_timeout_s=watchdog_s, on_hang=self._on_hang)
 
         m = self.obs
         self._m_sheds = m.counter(
@@ -172,6 +218,9 @@ class ServeFrontend:
         if self._warmup:
             self.warmup()
         self.slo.start(self.slo_interval_s)
+        self.flightrec.install()
+        if self._sup is not None:
+            self._sup.start_watchdog()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -272,6 +321,9 @@ class ServeFrontend:
 
     def _teardown(self) -> None:
         self.slo.stop()
+        self.flightrec.uninstall()
+        if self._sup is not None:
+            self._sup.stop_watchdog()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()
@@ -288,14 +340,17 @@ class ServeFrontend:
                 self._drain_control_queues()
                 progressed = False
                 if eng.scheduler.has_work():
-                    progressed = eng.step()
+                    progressed = self._step_once()
                     self._flush_finished()
                 now = time.monotonic()
                 if now >= self._dir_next:
-                    self._dir_next = now + _DIR_INTERVAL_S
+                    self._dir_next = now + self.dir_interval_s
                     snapshot = eng.kv_prefix_directory()
+                    debug = eng.debug_state()
                     with self._lock:
                         self._directory = snapshot
+                        self._debug_snapshot = debug
+                    self._check_slo_burn()
                 if self._draining:
                     if self._drain_finished():
                         break
@@ -305,6 +360,13 @@ class ServeFrontend:
                 if not progressed:
                     self._work.wait(0.02)
                     self._work.clear()
+        except Exception as e:
+            # an engine-loop crash is exactly what the flight recorder
+            # exists for: freeze the event ring + engine state before
+            # the thread dies, then re-raise so the failure stays loud
+            self.flightrec.dump("engine_exception", error=repr(e))
+            serve_event("serve_engine_crash", error=repr(e))
+            raise
         finally:
             if self._draining:
                 self.exit_code = PREEMPT_EXIT_CODE
@@ -313,6 +375,46 @@ class ServeFrontend:
                                           - self._drain_started, 3),
                             exit_code=self.exit_code)
             self._stopped.set()
+
+    def _step_once(self) -> bool:
+        """One engine step, under the hung-step watchdog when armed.
+        An armed chaos stall (POST /debug/stall/<s>) sleeps INSIDE the
+        watched window, so the watchdog observes it exactly like a real
+        wedged step and fires the postmortem hook mid-stall."""
+        with self._lock:
+            stall, self._stall_s = self._stall_s, 0.0
+        if self._sup is None:
+            if stall:
+                time.sleep(stall)
+            return self.engine.step()
+        with self._sup.watch_step(self.engine.steps):
+            if stall:
+                time.sleep(stall)
+            return self.engine.step()
+
+    def _check_slo_burn(self) -> None:
+        """Dump one flight-recorder bundle per burn EPISODE (edge
+        trigger): the moment an objective starts burning is when the
+        ring still holds the traffic that caused it."""
+        burning = self.slo.burning_objectives()
+        if burning and not self._burn_prev:
+            self.flightrec.dump("slo_burn", objectives=burning)
+        self._burn_prev = bool(burning)
+
+    def _on_hang(self, step: int, elapsed: float) -> None:
+        """RunSupervisor watchdog callback — runs on the WATCHDOG
+        thread while the engine thread is wedged; the snapshot is
+        best-effort by design (obs/flightrec.py)."""
+        self.flightrec.dump("watchdog_hang", step=step,
+                            elapsed_s=round(elapsed, 3))
+
+    def _flight_snapshot(self) -> dict:
+        state = self.engine.debug_state()
+        with self._lock:
+            state["open_streams"] = self._open_streams
+            state["active_req_ids"] = sorted(self._active)
+        state["draining"] = self._draining
+        return state
 
     def _drain_control_queues(self) -> None:
         """Apply handler-thread intents on the engine thread: new
@@ -340,6 +442,8 @@ class ServeFrontend:
                     fork_callback=_fork_cb,
                     callback=lambda tok, s=stream: s.q.put(("token", tok, 0)))
                 stream.req = req
+                self.engine.tracer.set_trace_id(
+                    req.req_id, p.get("trace_id"))
                 with self._lock:
                     self._active[req.req_id] = stream
             except Exception as e:       # bad prompt: surface as 400
@@ -413,6 +517,13 @@ class ServeFrontend:
         deadline_hit = (time.monotonic() - self._drain_started
                         > self.drain_deadline_s)
         if deadline_hit:
+            if not self._drain_dumped:
+                # dump BEFORE aborting so the snapshot still names the
+                # streams the deadline is about to cancel
+                self._drain_dumped = True
+                with self._lock:
+                    stuck = sorted(self._active)
+                self.flightrec.dump("drain_deadline", stuck_req_ids=stuck)
             self._abort_active("drain_deadline", count_drain=True)
         with self._lock:
             # read both under the lock: a handler that already popped its
@@ -440,13 +551,64 @@ class ServeFrontend:
         with self._lock:
             return {"prefixes": list(self._directory)}
 
+    def _debug_payload(self) -> dict:
+        """The /debug body: the engine-loop-refreshed scheduler/KV
+        snapshot plus front-end stream state — everything a handler
+        thread can serve without touching the engine."""
+        with self._lock:
+            return {
+                "engine": dict(self._debug_snapshot),
+                "open_streams": self._open_streams,
+                "active_req_ids": sorted(self._active),
+                "draining": self._draining,
+                "warm": self._warm,
+                "dir_interval_s": self.dir_interval_s,
+                "watchdog_s": (self._sup.watchdog_timeout_s
+                               if self._sup is not None else 0.0),
+            }
+
+    def _trace_route(self, path: str):
+        """GET /trace/<id> -> this replica's span fragment for one
+        fleet trace id (404 when the id never landed here — the router
+        probes every replica and keeps the ones that answer)."""
+        tid = path[len("/trace/"):].strip("/")
+        frag = self.engine.tracer.trace_fragment(tid)
+        if not tid or frag is None:
+            return 404, "application/json", b'{"error": "unknown trace"}\n'
+        return (200, "application/json",
+                json.dumps(frag).encode() + b"\n")
+
+    def _stall_route(self, path: str):
+        """GET /debug/stall/<seconds> (chaos builds only): arm a
+        deliberate sleep inside the next WATCHED engine step — the
+        fleet-obs bench cell's way of inducing a real stall."""
+        if not self._enable_chaos:
+            return (403, "application/json",
+                    b'{"error": "chaos routes disabled"}\n')
+        tail = path[len("/debug/stall"):].strip("/")
+        try:
+            seconds = float(tail) if tail else 1.0
+        except ValueError:
+            return 400, "application/json", b'{"error": "bad seconds"}\n'
+        seconds = max(0.0, min(seconds, 30.0))
+        with self._lock:
+            self._stall_s = seconds
+        self._work.set()
+        return (200, "application/json",
+                json.dumps({"stall_s": seconds}).encode() + b"\n")
+
     # -- HTTP handlers ----------------------------------------------------
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
         self._set_ready_gauge()     # traffic may have warmed the engine
         resp = obs_response(
             h.path, self.obs, readiness=self.readiness,
             routes={"/slo": json_route(self.slo.verdict),
-                    "/kvprefixes": json_route(self._directory_payload)})
+                    "/kvprefixes": json_route(self._directory_payload),
+                    "/debug": json_route(self._debug_payload),
+                    "/debug/flightrec": json_route(
+                        self.flightrec.debug_payload)},
+            prefix_routes={"/trace/": self._trace_route,
+                           "/debug/stall": self._stall_route})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
         self._send(h, *resp)
@@ -517,6 +679,11 @@ class ServeFrontend:
                 "stream": bool(body.get("stream", True)),
                 "n": n,
                 "best_of": best_of,
+                # fleet trace id: the router propagates its minted id
+                # via x-ptpu-trace; a direct client gets one minted
+                # here, so every stream is traceable either way
+                "trace_id": (h.headers.get("x-ptpu-trace")
+                             or uuid.uuid4().hex[:16]),
             }
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
             self._send(h, 400, "application/json",
@@ -608,7 +775,8 @@ class ServeFrontend:
                     frame = {"done": True, "reason": reason,
                              "tokens": tokens,
                              "req_id": stream.req.req_id
-                             if stream.req else None}
+                             if stream.req else None,
+                             "trace_id": stream.params.get("trace_id")}
                     if extra is not None:
                         frame.update(extra)
                     h.wfile.write(sse_event(frame))
@@ -647,6 +815,7 @@ class ServeFrontend:
                 payload = {
                     "tokens": full or tokens, "reason": reason,
                     "req_id": stream.req.req_id if stream.req else None,
+                    "trace_id": stream.params.get("trace_id"),
                 }
                 if extra is not None:
                     payload.update(extra)

@@ -62,6 +62,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
     p.add_argument("--default-max-new-tokens", type=int, default=32)
     p.add_argument("--default-deadline-ms", type=float, default=None)
+    # observability / postmortem
+    p.add_argument("--dir-interval-s", type=float, default=0.25,
+                   help="refresh cadence for the /kvprefixes "
+                        "advertisement, /debug snapshot and scheduler "
+                        "gauges")
+    p.add_argument("--watchdog-s", type=float, default=0.0,
+                   help="flag an engine step stuck longer than this "
+                        "and dump a flight-recorder bundle "
+                        "(0 disables the watchdog)")
+    p.add_argument("--flightrec-out", default=None,
+                   help="directory for postmortem flightrec-*.json "
+                        "bundles (omit to keep them in memory only, "
+                        "readable via /debug/flightrec)")
+    p.add_argument("--flightrec-capacity", type=int, default=256,
+                   help="events retained in the flight-recorder ring")
+    p.add_argument("--enable-chaos", action="store_true",
+                   help="mount GET /debug/stall/<s> (wedges the engine "
+                        "loop for <s> seconds — bench/test fault "
+                        "injection; NEVER enable in production)")
     # SLO objectives (obs/slo.py default_objectives)
     p.add_argument("--slo-ttft-ms", type=float, default=500.0)
     p.add_argument("--slo-tpot-ms", type=float, default=200.0)
@@ -127,7 +146,12 @@ def build_frontend(a: argparse.Namespace):
         max_queue_depth=a.max_queue_depth,
         drain_deadline_s=a.drain_deadline_s,
         default_max_new_tokens=a.default_max_new_tokens,
-        default_deadline_ms=a.default_deadline_ms)
+        default_deadline_ms=a.default_deadline_ms,
+        dir_interval_s=a.dir_interval_s,
+        watchdog_s=a.watchdog_s,
+        flightrec_out=a.flightrec_out,
+        flightrec_capacity=a.flightrec_capacity,
+        enable_chaos=a.enable_chaos)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -45,22 +45,44 @@ The proxy relays the replica's SSE byte stream unbuffered, so the
 client disconnect propagates: the router's write fails, it drops the
 replica connection, the replica's write fails, the engine cancels and
 frees KV blocks.
+
+FLEET OBSERVABILITY (OBSERVABILITY.md §fleet). The router is also the
+fleet's one observability front door:
+
+- every proxied request gets a TRACE ID (minted here, or the client's
+  own `x-ptpu-trace` passed through) injected on the replica hop; the
+  router records its own route/relay spans under the same id, and
+  `GET /trace/<id>` fetches each replica's span fragment and stitches
+  router + replica rows into ONE Chrome trace with per-process pids —
+  TTFT decomposes hop by hop;
+- `GET /metrics/fleet` scrapes every replica's exposition and serves
+  the federated merge (obs/fleetmetrics.py): counters sum exactly,
+  log-bucketed histograms merge bucket-by-bucket (identical layout by
+  construction), gauges re-label per replica;
+- `GET /debug` is the replica table as the router sees it — ready
+  state, scraped gauges, prefix-directory size, and scrape staleness
+  (also exported as `ptpu_router_scrape_age_seconds{replica}`, so
+  routing-on-stale-data is visible on the scrape plane too).
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import signal
 import threading
 import time
+import uuid
 import zlib
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
-from paddle_tpu.obs.http import obs_response
+from paddle_tpu.obs.fleetmetrics import federate
+from paddle_tpu.obs.http import CONTENT_TYPE, json_route, obs_response
 from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.obs.tracing import RequestTracer, stitch_fragments
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
 from paddle_tpu.serve.sse import parse_prometheus_values
 from paddle_tpu.utils.log import serve_event
@@ -167,6 +189,17 @@ class Router:
             "ptpu_router_replica_prefixes",
             "Warm prefixes the replica advertises on /kvprefixes",
             labelnames=("replica",))
+        self._m_scrape_age = self.obs.gauge(
+            "ptpu_router_scrape_age_seconds",
+            "Seconds since the replica's gauges were last scraped "
+            "successfully (-1 = never); routing decisions are only as "
+            "fresh as this", labelnames=("replica",))
+
+        # router-side spans under the fleet trace id: one synthetic
+        # request id per proxied POST, stitched with the replica's
+        # engine spans by /trace/<id>
+        self.tracer = RequestTracer(keep_last=512, process_name="router")
+        self._trace_seq = itertools.count(1)
 
         self._server: Optional[ThreadingHTTPServer] = None
         self._serve_thread: Optional[threading.Thread] = None
@@ -230,11 +263,16 @@ class Router:
                 r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
                 r.last_scrape = time.monotonic()
             hit_rate, queue_depth = r.hit_rate, r.queue_depth
+            last_scrape = r.last_scrape
         self._m_replica_ready.labels(replica=r.url).set(1.0 if ready else 0.0)
         self._m_replica_hit.labels(replica=r.url).set(hit_rate)
         self._m_replica_depth.labels(replica=r.url).set(queue_depth)
         self._m_replica_prefixes.labels(replica=r.url).set(
             float(len(prefixes)))
+        # staleness: keeps GROWING while scrapes fail, so alerting can
+        # tell "replica down" from "replica briefly slow"
+        age = (time.monotonic() - last_scrape) if last_scrape else -1.0
+        self._m_scrape_age.labels(replica=r.url).set(age)
 
     def scrape_now(self) -> None:
         """One synchronous pass over every replica (startup, tests)."""
@@ -391,8 +429,91 @@ class Router:
                 return True, ""
         return False, "no ready replicas"
 
+    def _fetch(self, r: ReplicaState, path: str) -> Optional[str]:
+        """GET `path` from a replica, body text on 200 else None. Runs
+        on handler threads with NO router lock held (network under the
+        lock is forbidden — see self._lock's comment)."""
+        try:
+            conn = HTTPConnection(r.host, r.port,
+                                  timeout=self.connect_timeout_s)
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                return body.decode("utf-8", "replace")
+            finally:
+                conn.close()
+        except OSError:
+            return None
+
+    def _fleet_route(self):
+        """/metrics/fleet: scrape every replica NOW and serve the
+        federated exposition. Unreachable replicas are simply absent
+        from the merge (their staleness still shows on the router's
+        own ptpu_router_scrape_age_seconds)."""
+        expositions: Dict[str, str] = {}
+        for r in self.replicas:
+            text = self._fetch(r, "/metrics")
+            if text is not None:
+                expositions[r.url] = text
+        return 200, CONTENT_TYPE, federate(expositions).encode()
+
+    def _trace_route(self, path: str):
+        """/trace/<id>: merge the router's own span fragment for the
+        trace id with every replica's into one Chrome trace — each
+        process gets its own pid row, timestamps are epoch-anchored
+        (now_us) so no shifting is needed."""
+        tid = path[len("/trace/"):].strip("/")
+        fragments: List[Tuple[str, dict]] = []
+        own = self.tracer.trace_fragment(tid) if tid else None
+        if own is not None:
+            fragments.append(("router", own))
+        for r in self.replicas:
+            text = self._fetch(r, "/trace/" + tid) if tid else None
+            if text is None:
+                continue
+            try:
+                frag = json.loads(text)
+            except ValueError:
+                continue
+            fragments.append((f"replica {r.url}", frag))
+        if not fragments:
+            return (404, "application/json",
+                    json.dumps({"error": "unknown trace",
+                                "trace_id": tid}).encode() + b"\n")
+        merged = stitch_fragments(fragments, trace_id=tid)
+        return (200, "application/json",
+                json.dumps(merged).encode() + b"\n")
+
+    def _debug_payload(self) -> dict:
+        """/debug: the replica table as routing sees it right now."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = [{
+                "url": r.url,
+                "ready": r.ready,
+                "reason": r.reason,
+                "hit_rate": r.hit_rate,
+                "queue_depth": r.queue_depth,
+                "scrape_age_s": (round(now - r.last_scrape, 3)
+                                 if r.last_scrape else None),
+                "prefixes": len(r.prefixes),
+            } for r in self.replicas]
+            inflight = self._inflight
+            draining = self._draining
+        return {"replicas": replicas, "inflight": inflight,
+                "draining": draining,
+                "scrape_interval_s": self.scrape_interval_s,
+                "directory_enabled": self.enable_directory}
+
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
-        resp = obs_response(h.path, self.obs, readiness=self.readiness)
+        resp = obs_response(
+            h.path, self.obs, readiness=self.readiness,
+            routes={"/metrics/fleet": self._fleet_route,
+                    "/debug": json_route(self._debug_payload)},
+            prefix_routes={"/trace/": self._trace_route})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
         status, ctype, body = resp
@@ -432,13 +553,22 @@ class Router:
             prompt = json.loads(raw or b"{}").get("prompt") or []
         except (ValueError, json.JSONDecodeError):
             raw, prompt = b"{}", []
+        # fleet trace id: honor the client's, else mint one; the same
+        # id tags the router's route/relay spans AND rides the replica
+        # hop as x-ptpu-trace, so /trace/<id> can stitch both processes
+        tid = h.headers.get("x-ptpu-trace") or uuid.uuid4().hex[:16]
+        rid = next(self._trace_seq)
+        self.tracer.set_trace_id(rid, tid)
+        self.tracer.span_begin(rid, "route")
         candidates, dir_pick = self._plan(prompt)
         if not candidates:
+            self.tracer.on_finish(rid, "shed")
             self._shed(h, "no_replica")
             return
         self._track_inflight(+1)
         try:
-            self._proxy(h, raw, prompt, candidates, dir_pick)
+            self._proxy(h, raw, prompt, candidates, dir_pick,
+                        tid=tid, rid=rid)
         finally:
             self._track_inflight(-1)
 
@@ -455,7 +585,9 @@ class Router:
     def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
                prompt: Sequence[int],
                candidates: List[ReplicaState],
-               dir_pick: Optional[ReplicaState] = None) -> None:
+               dir_pick: Optional[ReplicaState] = None, *,
+               tid: Optional[str] = None,
+               rid: Optional[int] = None) -> None:
         """Try candidates in order; a refused connection or a 503 shed
         moves to the next. The first streamable response is relayed
         byte-for-byte (SSE frames pass through untouched). The served
@@ -465,23 +597,29 @@ class Router:
         prefix directory OVERRODE the hash, "fallback" otherwise."""
         sticky = self.replicas[prefix_shard(prompt, len(self.replicas),
                                             self.prefix_len)]
+        headers = {"Content-Type": "application/json"}
+        if tid:
+            headers["x-ptpu-trace"] = tid
         last_resp: Optional[Tuple[int, bytes]] = None
         for r in candidates:
             try:
                 conn = HTTPConnection(r.host, r.port,
                                       timeout=self.connect_timeout_s)
                 conn.request(
-                    "POST", "/v1/completions", body=raw,
-                    headers={"Content-Type": "application/json"})
+                    "POST", "/v1/completions", body=raw, headers=headers)
                 resp = conn.getresponse()
             except OSError:
                 with self._lock:
                     r.ready = False
                     r.reason = "connect failed"
+                if rid is not None:
+                    self.tracer.mark(rid, "connect_failed", replica=r.url)
                 continue
             if resp.status == 503:      # replica shed: try the next
                 last_resp = (503, resp.read())
                 conn.close()
+                if rid is not None:
+                    self.tracer.mark(rid, "replica_shed", replica=r.url)
                 continue
             if r is sticky:
                 kind = "primary"
@@ -492,9 +630,16 @@ class Router:
             if dir_pick is not None and r is dir_pick:
                 self._m_dir_hits.inc()
             self._m_routed.labels(replica=r.url, kind=kind).inc()
+            if rid is not None:
+                self.tracer.mark(rid, "routed", replica=r.url, kind=kind)
+                self.tracer.span_begin(rid, "relay")
             self._relay(h, resp)
             conn.close()
+            if rid is not None:
+                self.tracer.on_finish(rid, "relayed")
             return
+        if rid is not None:
+            self.tracer.on_finish(rid, "shed")
         if last_resp is not None:       # every replica shed: relay it
             status, body = last_resp
             try:

@@ -102,29 +102,36 @@ def _connect(url: str, timeout: float) -> Tuple[HTTPConnection, str]:
 
 
 def stream_completion(base_url: str, payload: dict,
-                      timeout: float = 120.0) -> SSEStream:
+                      timeout: float = 120.0,
+                      headers: Optional[dict] = None) -> SSEStream:
     """POST `payload` to `{base_url}/v1/completions` and return the
     live SSE stream (status != 200 means shed/error — read
-    `.resp.read()` for the body)."""
+    `.resp.read()` for the body). Extra `headers` merge over the
+    defaults — how a client pins its own `x-ptpu-trace` id."""
     conn, _ = _connect(base_url, timeout)
     body = json.dumps(payload).encode()
-    conn.request("POST", "/v1/completions", body=body,
-                 headers={"Content-Type": "application/json",
-                          "Accept": "text/event-stream"})
+    hdrs = {"Content-Type": "application/json",
+            "Accept": "text/event-stream"}
+    if headers:
+        hdrs.update(headers)
+    conn.request("POST", "/v1/completions", body=body, headers=hdrs)
     return SSEStream(conn, conn.getresponse())
 
 
 def collect_stream(base_url: str, payload: dict,
-                   timeout: float = 120.0) -> dict:
+                   timeout: float = 120.0,
+                   headers: Optional[dict] = None) -> dict:
     """Drive one streaming completion to the end; returns
     {status, tokens, done (saw [DONE]), final (the done frame or
-    None), shed_body (on non-200)}."""
-    s = stream_completion(base_url, payload, timeout=timeout)
+    None), trace_id (from the done frame — the handle for the fleet's
+    /trace/<id>), shed_body (on non-200)}."""
+    s = stream_completion(base_url, payload, timeout=timeout,
+                          headers=headers)
     if s.status != 200:
         body = s.resp.read().decode("utf-8", "replace")
         s.close()
         return {"status": s.status, "tokens": [], "done": False,
-                "final": None, "shed_body": body}
+                "final": None, "trace_id": None, "shed_body": body}
     tokens, final = [], None
     for ev in s.events():
         if "token" in ev:
@@ -132,7 +139,9 @@ def collect_stream(base_url: str, payload: dict,
         if ev.get("done"):
             final = ev
     return {"status": 200, "tokens": tokens, "done": s.done,
-            "final": final, "shed_body": None}
+            "final": final,
+            "trace_id": (final or {}).get("trace_id"),
+            "shed_body": None}
 
 
 def http_get(url: str, timeout: float = 10.0) -> Tuple[int, str]:

@@ -25,7 +25,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 _LOGGER = logging.getLogger("paddle_tpu")
 if not _LOGGER.handlers:
@@ -95,6 +95,31 @@ _STREAMS: Dict[str, logging.Logger] = {}
 _SEQ: Dict[str, int] = {}
 _SEQ_LOCK = threading.Lock()
 
+# In-process event taps: callables invoked with (stream, record) for
+# every emitted event AFTER it hits stdout. The flight recorder
+# (obs/flightrec.py) rides this to keep a postmortem ring of recent
+# serve/resilience events without touching any emit site. Taps run
+# OUTSIDE _SEQ_LOCK and exceptions are swallowed — a broken tap must
+# never take the run down or reorder sequence numbers.
+_TAPS: List[Callable[[str, dict], None]] = []   # guarded-by: _TAPS_LOCK
+_TAPS_LOCK = threading.Lock()
+
+
+def add_event_tap(fn: Callable[[str, dict], None]) -> None:
+    """Register a tap called with (stream, record) for every event."""
+    with _TAPS_LOCK:
+        if fn not in _TAPS:
+            _TAPS.append(fn)
+
+
+def remove_event_tap(fn: Callable[[str, dict], None]) -> None:
+    """Unregister a tap; unknown taps are ignored."""
+    with _TAPS_LOCK:
+        try:
+            _TAPS.remove(fn)
+        except ValueError:
+            pass
+
 
 def _stream_logger(stream: str) -> logging.Logger:
     lg = _STREAMS.get(stream)
@@ -124,6 +149,13 @@ def emit_event(stream: str, evt: str, **fields) -> dict:
     rec["seq"] = seq
     _stream_logger(stream).info(
         json.dumps(rec, sort_keys=False, default=str))
+    with _TAPS_LOCK:
+        taps = list(_TAPS)
+    for tap in taps:
+        try:
+            tap(stream, rec)
+        except Exception:
+            pass  # a broken tap must never take the run down
     return rec
 
 

@@ -1,0 +1,14 @@
+"""TS006 fixture: the /debug + /trace JSON surface is closed-world.
+
+The fixture OBSERVABILITY.md documents `/debug/ok` and the `/trace/`
+prefix; anything else under those namespaces must be flagged, including
+the static prefix of a constructed path.
+"""
+
+DOCUMENTED_EXACT = "/debug/ok"          # listed in the fixture doc: clean
+DOCUMENTED_PREFIX = "/trace/abc123"     # covered by the `/trace/` row
+UNDOCUMENTED = "/debug/bogus"           # expect: TS006
+
+
+def build_url(base, tid):
+    return base + "/trace-dump/" + tid  # expect: TS006

@@ -65,7 +65,8 @@ def test_repo_gate_is_green():
 
 # -- fixture-driven pass tests ----------------------------------------------
 
-BAD = ["bad_trace.py", "bad_locks.py", "bad_telemetry.py", "bad_hygiene.py"]
+BAD = ["bad_trace.py", "bad_locks.py", "bad_telemetry.py", "bad_hygiene.py",
+       "bad_routes.py"]
 GOOD = ["good_trace.py", "good_locks.py", "good_telemetry.py",
         "good_hygiene.py"]
 

@@ -346,6 +346,241 @@ def test_merged_chrome_trace_structure(tmp_path):
     assert json.loads(out.read_text())["traceEvents"]
 
 
+# -- tracer churn + fleet trace ids -----------------------------------------
+
+def test_tracer_churn_span_ordering():
+    # preemption re-entry must keep span rows in lifecycle order on the
+    # request's timeline: queued, prefill, queued (re-entry), prefill,
+    # decode — monotone start timestamps
+    tr = RequestTracer()
+    _trace_one_lifecycle(tr, 7, preempt=True)
+    spans = [e for e in tr.to_chrome_trace()["traceEvents"]
+             if e["ph"] == "X" and e["tid"] == 7]
+    assert [s["name"] for s in spans] == \
+        ["queued", "prefill", "queued", "prefill", "decode"]
+    ts = [s["ts"] for s in spans]
+    assert ts == sorted(ts)
+
+
+def test_tracer_trace_id_round_trip():
+    tr = RequestTracer()
+    tr.set_trace_id(1, "abc123")
+    _trace_one_lifecycle(tr, 1)
+    assert tr.trace_id_of(1) == "abc123"
+    assert tr.request_of_trace("abc123") == 1
+    frag = tr.trace_fragment("abc123")
+    assert frag["trace_id"] == "abc123" and frag["req_id"] == 1
+    spans = [e for e in frag["traceEvents"] if e["ph"] == "X"]
+    assert spans and all(e["args"]["trace_id"] == "abc123" for e in spans)
+    assert tr.trace_fragment("nope") is None
+
+
+def test_tracer_eviction_drops_trace_ids():
+    # the id maps must stay bounded by keep_last exactly like the done
+    # deque: evicted requests lose their trace-id resolution
+    tr = RequestTracer(keep_last=2)
+    for rid in range(5):
+        tr.set_trace_id(rid, f"tid{rid}")
+        _trace_one_lifecycle(tr, rid)
+    for evicted in ("tid0", "tid1", "tid2"):
+        assert tr.request_of_trace(evicted) is None
+        assert tr.trace_fragment(evicted) is None
+    assert tr.request_of_trace("tid3") == 3
+    assert tr.request_of_trace("tid4") == 4
+    assert tr.trace_fragment("tid4")["req_id"] == 4
+
+
+def test_merged_trace_thread_names_survive_churn():
+    # bounded retention under churn: the merged trace keeps one
+    # thread_name meta per RETAINED request, none for evicted ones
+    tr = RequestTracer(keep_last=4)
+    for rid in range(6):
+        _trace_one_lifecycle(tr, rid, preempt=(rid % 2 == 0))
+    trace = merged_chrome_trace(tr, include_host_spans=False)
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert names == {f"req {r}" for r in range(2, 6)}
+
+
+def test_stitch_fragments_cross_process():
+    from paddle_tpu.obs import stitch_fragments
+
+    router = RequestTracer(process_name="router")
+    router.set_trace_id(1, "tid9")
+    router.span_begin(1, "route")
+    router.mark(1, "routed", replica="http://r0")
+    router.span_begin(1, "relay")
+    router.on_finish(1, "relayed")
+    engine = RequestTracer(process_name="replica")
+    engine.set_trace_id(42, "tid9")
+    _trace_one_lifecycle(engine, 42)
+    merged = stitch_fragments(
+        [("router", router.trace_fragment("tid9")),
+         ("replica http://r0", engine.trace_fragment("tid9"))],
+        trace_id="tid9")
+    assert merged["trace_id"] == "tid9"
+    evs = merged["traceEvents"]
+    spans = [e for e in evs if e["ph"] == "X"]
+    assert len({e["pid"] for e in spans}) == 2      # one pid per process
+    assert {"route", "relay", "queued", "prefill", "decode"} <= \
+        {e["name"] for e in spans}
+    assert {e["args"].get("trace_id") for e in spans} == {"tid9"}
+
+
+# -- fleet federation --------------------------------------------------------
+
+def _replica_exposition(reason_counts, latencies):
+    reg = MetricsRegistry()
+    c = reg.counter("t_req_total", "Requests", labelnames=("reason",))
+    for reason, n in reason_counts.items():
+        c.labels(reason=reason).inc(n)
+    h = reg.histogram("t_lat_ms", "Latency")
+    for v in latencies:
+        h.observe(v)
+    reg.gauge("t_occ", "Occupancy").set(0.5)
+    return reg.render_prometheus()
+
+
+def test_federate_counters_sum_exactly():
+    from paddle_tpu.obs import counter_totals, federate
+
+    a = _replica_exposition({"length": 3, "stop": 1}, [1.0, 10.0])
+    b = _replica_exposition({"length": 2}, [100.0])
+    fleet = federate({"http://r0": a, "http://r1": b})
+    totals = counter_totals(fleet)
+    assert totals["t_req_total"] == \
+        counter_totals(a)["t_req_total"] + counter_totals(b)["t_req_total"]
+    # per-label-set exactness, not just the family total
+    assert 't_req_total{reason="length"} 5' in fleet
+    assert 't_req_total{reason="stop"} 1' in fleet
+
+
+def test_federate_histogram_buckets_merge_exactly():
+    from paddle_tpu.obs import federate, histogram_buckets
+
+    a = _replica_exposition({}, [0.5, 5.0, 50.0])
+    b = _replica_exposition({}, [5.0, 5000.0])
+    fleet = federate({"r0": a, "r1": b})
+    fa = histogram_buckets(a, "t_lat_ms")
+    fb = histogram_buckets(b, "t_lat_ms")
+    merged = histogram_buckets(fleet, "t_lat_ms")
+    assert set(merged) == set(fa) | set(fb)
+    for le, v in merged.items():
+        assert v == fa.get(le, 0.0) + fb.get(le, 0.0)
+    assert merged["+Inf"] == 5.0                    # pooled count
+
+
+def test_federate_gauges_get_replica_label():
+    from paddle_tpu.obs import federate
+
+    a = _replica_exposition({}, [])
+    b = _replica_exposition({}, [])
+    fleet = federate({"r0": a, "r1": b})
+    assert 't_occ{replica="r0"} 0.5' in fleet
+    assert 't_occ{replica="r1"} 0.5' in fleet
+    # the merge is itself a valid exposition for downstream consumers
+    from paddle_tpu.serve.sse import parse_prometheus_values
+    vals = parse_prometheus_values(fleet)
+    assert vals['t_occ{replica="r0"}'] == 0.5
+
+
+# -- event taps + flight recorder --------------------------------------------
+
+def test_event_taps_receive_and_remove(capsys):
+    from paddle_tpu.utils.log import (add_event_tap, remove_event_tap,
+                                      serve_event)
+    seen = []
+
+    def tap(stream, rec):
+        seen.append((stream, rec["evt"]))
+
+    add_event_tap(tap)
+    try:
+        serve_event("t_tap_evt")
+    finally:
+        remove_event_tap(tap)
+    serve_event("t_tap_after")                      # tap removed: unseen
+    assert seen == [("serve", "t_tap_evt")]
+
+
+def test_event_tap_errors_do_not_break_emit(capsys):
+    from paddle_tpu.utils.log import (add_event_tap, remove_event_tap,
+                                      serve_event)
+
+    def bad(stream, rec):
+        raise RuntimeError("tap boom")
+
+    add_event_tap(bad)
+    try:
+        rec = serve_event("t_tap_survives")
+    finally:
+        remove_event_tap(bad)
+    assert rec["evt"] == "t_tap_survives"           # emit unaffected
+
+
+def test_flightrec_ring_is_bounded(capsys):
+    from paddle_tpu.obs import FlightRecorder
+    from paddle_tpu.utils.log import obs_event, serve_event
+
+    fr = FlightRecorder(capacity=3, streams=("serve",))
+    with fr:
+        for i in range(5):
+            serve_event("t_evt", i=i)
+        obs_event("t_other")                        # filtered stream
+    ring = fr.ring()
+    assert [r["i"] for r in ring] == [2, 3, 4]      # oldest dropped
+    assert all(r["stream"] == "serve" for r in ring)
+    serve_event("t_evt", i=99)                      # after uninstall
+    assert [r["i"] for r in fr.ring()] == [2, 3, 4]
+
+
+def test_flightrec_dump_bundle(tmp_path, capsys):
+    from paddle_tpu.obs import FlightRecorder
+
+    reg = MetricsRegistry()
+    fr = FlightRecorder(capacity=8, snapshot_fn=lambda: {"queue": [1, 2]},
+                        out_dir=str(tmp_path), registry=reg)
+    fr.record("serve", "breadcrumb", step=7)
+    bundle = fr.dump("watchdog_hang", step=7)
+    assert bundle["trigger"] == "watchdog_hang"
+    assert bundle["context"] == {"step": 7}
+    assert bundle["state"] == {"queue": [1, 2]}
+    assert [e["evt"] for e in bundle["events"]] == ["breadcrumb"]
+    with open(bundle["path"]) as f:
+        on_disk = json.load(f)
+    assert on_disk["trigger"] == "watchdog_hang"
+    assert reg.get("ptpu_flightrec_dumps_total").labels(
+        trigger="watchdog_hang").value == 1
+    payload = fr.debug_payload()
+    assert payload["last"]["trigger"] == "watchdog_hang"
+    assert payload["dumps"] == [bundle["path"]]
+
+
+def test_flightrec_snapshot_error_is_captured(capsys):
+    from paddle_tpu.obs import FlightRecorder
+
+    def boom():
+        raise RuntimeError("wedged")
+
+    bundle = FlightRecorder(snapshot_fn=boom).dump("slo_burn")
+    assert bundle["state"] == {"snapshot_error": "RuntimeError('wedged')"}
+
+
+def test_obs_response_prefix_routes():
+    from paddle_tpu.obs import obs_response
+
+    reg = MetricsRegistry()
+
+    def trace_route(path):
+        return 200, "application/json", json.dumps({"path": path}).encode()
+
+    status, _, body = obs_response("/trace/abc?x=1", reg,
+                                   prefix_routes={"/trace/": trace_route})
+    assert status == 200
+    assert json.loads(body) == {"path": "/trace/abc"}  # query stripped
+    assert obs_response("/nope", reg) is None
+
+
 # -- engine integration -----------------------------------------------------
 
 @pytest.mark.serve

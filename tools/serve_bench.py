@@ -53,13 +53,21 @@ drives them over HTTP:
            Cold/warm cells flush as measured.
 - router:  the end-to-end scale-out story (serve/). Boots replica
            subprocesses (`python -m paddle_tpu.serve.replica`) with
-           identical weights and a Router over them, then gates three
+           identical weights and a Router over them, then gates four
            verdicts on SCRAPED /metrics — (a) prefix-hash sticky
            routing holds the 2-replica fleet hit rate within 5% of a
            single replica's on shared-system-prompt traffic, with
-           byte-identical tokens; (b) SIGTERM of one replica drains
-           every in-flight stream to `[DONE]` with zero token loss,
-           exits 75, and traffic fails over to the survivor; (c) SLO
+           byte-identical tokens; (b) the fleet observability surface
+           (the fleet-obs cell): one request traced through the
+           router stitches into a single Chrome trace carrying router
+           AND replica spans under one trace id, /metrics/fleet
+           equals the sum of the per-replica scrapes (exact for
+           counters, per-`le` exact for histograms), and an induced
+           engine stall on a chaos replica dumps a flight-recorder
+           bundle naming the stuck request — compile gauge pinned at
+           1 throughout; (c) SIGTERM of one replica drains every
+           in-flight stream to `[DONE]` with zero token loss, exits
+           75, and traffic fails over to the survivor; (d) SLO
            admission control sheds nothing at nominal load, sheds
            nonzero (reason slo_*) under 2x overload, and keeps the
            admitted p99 TTFT under the configured deadline.
@@ -84,6 +92,9 @@ Run: python tools/serve_bench.py
      [--trace-out FILE]     # dump the last in-process verdict engine's
                             # request-lifecycle Chrome trace
                             # (chrome://tracing / perfetto)
+     [--postmortem-out FILE]  # when any cell failed, save the most
+                            # recent flight-recorder bundle captured
+                            # during the run (the fleet-obs stall's)
 """
 
 import argparse
@@ -92,6 +103,7 @@ import os
 import re
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 
@@ -106,6 +118,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LAST_EXPOSITION = ""
 # that engine's RequestTracer; --trace-out dumps its Chrome trace
 LAST_TRACER = None
+# most recent flight-recorder bundle observed (the fleet-obs cell's
+# induced stall); --postmortem-out writes it when a cell failed
+LAST_POSTMORTEM = None
 
 
 def emit(obj):
@@ -897,6 +912,151 @@ def _phase_sticky(args, router, reqs):
                 "tokens_identical": bool(identical)}
 
 
+def _phase_fleet_obs(args, router, rng, flightrec_dir):
+    """The fleet observability surface end to end (OBSERVABILITY.md):
+    (a) one request traced THROUGH the router must stitch into a
+    single Chrome trace with router + replica spans under one trace
+    id; (b) the router's /metrics/fleet body must equal the sum of
+    the per-replica scrapes — exact for counters, per-`le` exact for
+    histograms — with every replica's scrape-age gauge fresh; (c) an
+    induced engine stall on a chaos replica must dump a
+    flight-recorder bundle naming the stuck request, with the compile
+    gauge still pinned at 1."""
+    global LAST_POSTMORTEM
+    from paddle_tpu.obs.fleetmetrics import (counter_totals,
+                                             histogram_buckets)
+    from paddle_tpu.serve.sse import (collect_stream, http_get,
+                                      stream_completion)
+
+    # (a) cross-process trace stitching: the done frame hands back the
+    # router-minted trace id; /trace/<id> on the router must answer
+    # with the stitched timeline — its own route/relay rows plus the
+    # serving replica's queued/prefill/decode rows, distinct pids,
+    # every span arg-tagged with the one trace id
+    out = collect_stream(
+        router.url,
+        {"prompt": rng.integers(0, _REPLICA_VOCAB - 1, 8).tolist(),
+         "max_new_tokens": args.router_new_tokens})
+    tid = out["trace_id"]
+    status, body = http_get(router.url + "/trace/" + (tid or "unknown"))
+    trace = json.loads(body) if status == 200 else {}
+    spans = [ev for ev in trace.get("traceEvents", ())
+             if ev.get("ph") == "X"]
+    pids = {ev["pid"] for ev in spans}
+    names = {ev["name"] for ev in spans}
+    tids = {ev.get("args", {}).get("trace_id") for ev in spans}
+    trace_ok = bool(out["done"] and tid and status == 200
+                    and len(pids) >= 2          # router + replica
+                    and "relay" in names        # router-side rows
+                    and {"prefill", "decode"} & names   # replica rows
+                    and tids == {tid})
+    emit({"cell": "fleet_trace", "ok": trace_ok, "trace_id": tid,
+          "status": status, "spans": len(spans),
+          "processes": len(pids), "span_names": sorted(names)})
+
+    # (b) federated metrics: no traffic is in flight, so the fleet
+    # body and the per-replica scrapes read the same frozen counters
+    replica_texts = {r.url: http_get(r.url + "/metrics")[1]
+                     for r in router.replicas}
+    status_f, fleet_text = http_get(router.url + "/metrics/fleet")
+    fleet_counters = counter_totals(fleet_text)
+    summed = {}
+    for text in replica_texts.values():
+        for k, v in counter_totals(text).items():
+            summed[k] = summed.get(k, 0.0) + v
+    counters_exact = bool(
+        summed and set(fleet_counters) == set(summed)
+        and all(abs(fleet_counters[k] - v) < 1e-9
+                for k, v in summed.items()))
+    fam = "ptpu_serve_ttft_ms"
+    fleet_buckets = histogram_buckets(fleet_text, fam)
+    merged = {}
+    for text in replica_texts.values():
+        for le, v in histogram_buckets(text, fam).items():
+            merged[le] = merged.get(le, 0.0) + v
+    hist_exact = bool(merged and fleet_buckets == merged
+                      and merged.get("+Inf", 0.0) > 0)
+    age_fam = router.obs.get("ptpu_router_scrape_age_seconds")
+    ages = [age_fam.labels(replica=r.url).value
+            for r in router.replicas]
+    ages_fresh = bool(ages and all(0.0 <= a < 10.0 for a in ages))
+    metrics_ok = bool(status_f == 200 and counters_exact and hist_exact
+                      and ages_fresh)
+    emit({"cell": "fleet_metrics", "ok": metrics_ok,
+          "counter_families": len(summed),
+          "counters_exact": counters_exact, "hist_family": fam,
+          "hist_exact": hist_exact,
+          "ttft_observations": merged.get("+Inf", 0.0),
+          "max_scrape_age_s": round(max(ages), 3) if ages else None})
+
+    # (c) induced stall -> postmortem: a dedicated chaos replica with
+    # a 0.5s watchdog; two tokens into a live stream we wedge the next
+    # engine step for 3s via /debug/stall, so the watchdog fires
+    # mid-stall and the bundle freezes the stuck request's state. The
+    # burn threshold is parked sky-high so the stall's bundle is the
+    # only dump.
+    proc, base = _spawn_replica(extra=(
+        "--watchdog-s", "0.5", "--flightrec-out", flightrec_dir,
+        "--enable-chaos", "--dir-interval-s", "0.1",
+        "--slo-burn-threshold", "1e9"))
+    bundle, final, vals = None, None, {}
+    try:
+        s = stream_completion(
+            base,
+            {"prompt": rng.integers(0, _REPLICA_VOCAB - 1, 4).tolist(),
+             "max_new_tokens": 48}, timeout=120)
+        it = s.events()
+        seen = 0
+        for ev in it:
+            seen += 1 if "token" in ev else 0
+            if ev.get("done"):
+                final = ev
+            if seen == 2:       # provably mid-generation
+                break
+        http_get(base + "/debug/stall/3")
+        for ev in it:
+            if ev.get("done"):
+                final = ev
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and bundle is None:
+            payload = json.loads(http_get(base + "/debug/flightrec")[1])
+            last = payload.get("last")
+            if last and last.get("trigger") == "watchdog_hang":
+                bundle = last
+            else:
+                time.sleep(0.2)
+        vals = _scrape(base)
+    finally:
+        _terminate(proc)
+
+    rid = (final or {}).get("req_id")
+    state = (bundle or {}).get("state", {})
+    running_ids = [r.get("req_id") for r in state.get("running", ())]
+    named = bool(rid is not None
+                 and (rid in state.get("active_req_ids", ())
+                      or rid in running_ids))
+    compiles = vals.get("ptpu_engine_compiles")
+    dumps = vals.get(
+        'ptpu_flightrec_dumps_total{trigger="watchdog_hang"}', 0.0)
+    flightrec_ok = bool(bundle is not None and s.done
+                        and final is not None and named
+                        and "pool" in state
+                        and bundle.get("path")   # --flightrec-out wrote
+                        and dumps >= 1.0 and compiles == 1.0)
+    if bundle is not None:
+        LAST_POSTMORTEM = bundle
+    emit({"cell": "fleet_flightrec", "ok": flightrec_ok,
+          "trigger": (bundle or {}).get("trigger"),
+          "stuck_req_id": rid, "named_in_bundle": named,
+          "ring_events": len((bundle or {}).get("events", ())),
+          "bundle_path": (bundle or {}).get("path"),
+          "watchdog_dumps": dumps, "compiles": compiles})
+
+    ok = bool(trace_ok and metrics_ok and flightrec_ok)
+    return ok, {"trace_ok": trace_ok, "fleet_metrics_ok": metrics_ok,
+                "flightrec_ok": flightrec_ok}
+
+
 def _phase_drain(args, router, procs, systems, rng):
     """SIGTERM one replica while streams it serves are mid-flight:
     every stream must still end in [DONE] with the full token count
@@ -1066,8 +1226,11 @@ def scenario_router(model, variables, args):
     router = Router([base for _, base in procs],
                     prefix_len=args.router_system_len,
                     scrape_interval_s=0.2).start()
+    flightrec_dir = tempfile.mkdtemp(prefix="ptpu-flightrec-")
     try:
         ok_sticky, sticky = _phase_sticky(args, router, reqs)
+        ok_obs, fleet_obs = _phase_fleet_obs(args, router, rng,
+                                             flightrec_dir)
         ok_drain, drain = _phase_drain(args, router, procs, systems, rng)
     finally:
         router.stop()
@@ -1075,10 +1238,11 @@ def scenario_router(model, variables, args):
             _terminate(proc)
     ok_slo, slo = _phase_slo(args, rng)
 
-    ok = bool(ok_sticky and ok_drain and ok_slo)
+    ok = bool(ok_sticky and ok_obs and ok_drain and ok_slo)
     emit({"cell": "router_verdict", "ok": ok,
-          "sticky_ok": ok_sticky, "drain_ok": ok_drain,
-          "slo_ok": ok_slo, **sticky, **drain, **slo})
+          "sticky_ok": ok_sticky, "fleet_obs_ok": ok_obs,
+          "drain_ok": ok_drain, "slo_ok": ok_slo,
+          **sticky, **fleet_obs, **drain, **slo})
     return ok
 
 
@@ -1130,6 +1294,10 @@ def main():
     ap.add_argument("--trace-out", default=None, metavar="FILE",
                     help="write the last in-process verdict engine's "
                     "request-lifecycle Chrome trace here at end of run")
+    ap.add_argument("--postmortem-out", default=None, metavar="FILE",
+                    help="when any cell failed, write the most recent "
+                    "flight-recorder bundle captured during the run "
+                    "(the fleet-obs cell's induced-stall bundle) here")
     args = ap.parse_args()
 
     model, variables = build_model(args)
@@ -1157,6 +1325,18 @@ def main():
             trace = merged_chrome_trace(LAST_TRACER, path=args.trace_out)
             emit({"cell": "trace_out", "path": args.trace_out,
                   "events": len(trace["traceEvents"])})
+    if args.postmortem_out:
+        failed = sorted(k for k, v in oks.items() if not v)
+        if failed and LAST_POSTMORTEM is not None:
+            with open(args.postmortem_out, "w") as f:
+                json.dump(LAST_POSTMORTEM, f, default=str)
+            emit({"cell": "postmortem_out", "path": args.postmortem_out,
+                  "trigger": LAST_POSTMORTEM.get("trigger"),
+                  "failed": failed})
+        else:
+            emit({"cell": "postmortem_out", "path": None, "failed": failed,
+                  "skipped": ("all cells passed" if not failed
+                              else "no flight-recorder bundle captured")})
     emit({"cell": "TOTAL", "ok": all(oks.values()), **oks})
     return 0 if all(oks.values()) else 1
 
