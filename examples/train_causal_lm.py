@@ -55,7 +55,18 @@ def main():
                          "reschedulable exit)")
     ap.add_argument("--save-every", type=int, default=20,
                     help="checkpoint cadence in steps (with --ckpt-dir)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve training telemetry (step phases, goodput, "
+                         "MFU, device memory) at :PORT/metrics while the "
+                         "resilient loop runs (needs --ckpt-dir)")
+    ap.add_argument("--flightrec-dir", default=None,
+                    help="dump a postmortem bundle here when the watchdog "
+                         "flags a hung step or the loop crashes "
+                         "(needs --ckpt-dir)")
     args = ap.parse_args()
+    if (args.metrics_port or args.flightrec_dir) and not args.ckpt_dir:
+        ap.error("--metrics-port/--flightrec-dir ride on the resilient "
+                 "loop: pass --ckpt-dir too")
 
     on_tpu = jax.devices()[0].platform == "tpu"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
@@ -98,10 +109,48 @@ def main():
             if step % 10 == 0 or step == args.steps - 1:
                 print(f"step {step:4d}  loss {float(out['loss']):.4f}")
 
-        ts = train_resilient(trainer, ts, lambda step: batch, args.steps,
-                             manager, start_step=start,
-                             save_every=args.save_every,
-                             rng_for_step=jax.random.key, on_step=on_step)
+        # Training telemetry (OBSERVABILITY.md "Training telemetry"):
+        # one registry feeds the scrape server, the goodput ledger, the
+        # MFU gauge (absent where the platform peak is unknown), the
+        # per-device memory gauges and the flight recorder's snapshot.
+        import contextlib
+
+        from paddle_tpu.obs import (
+            DeviceMemoryMonitor, FlightRecorder, GoodputLedger,
+            MetricsServer, default_registry)
+        from paddle_tpu.obs.goodput import causal_lm_step_flops, param_count
+
+        telemetry = {}
+        srv = contextlib.nullcontext()
+        if args.metrics_port or args.flightrec_dir:
+            reg = default_registry()
+            flops = causal_lm_step_flops(
+                batch_size=args.batch, seq_len=args.seq, d_model=args.dim,
+                n_layers=args.layers, n_params=param_count(ts.params))
+            telemetry = dict(registry=reg,
+                             goodput=GoodputLedger(registry=reg),
+                             flops_per_step=flops,
+                             memory_monitor=DeviceMemoryMonitor(registry=reg))
+            if args.flightrec_dir:
+                telemetry["flight_recorder"] = FlightRecorder(
+                    streams=("resilience", "obs"),
+                    snapshot_fn=lambda: {"metrics": reg.snapshot()},
+                    out_dir=args.flightrec_dir, registry=reg)
+            if args.metrics_port:
+                srv = MetricsServer(reg, port=args.metrics_port)
+
+        with srv:
+            ts = train_resilient(trainer, ts, lambda step: batch, args.steps,
+                                 manager, start_step=start,
+                                 save_every=args.save_every,
+                                 rng_for_step=jax.random.key,
+                                 on_step=on_step, **telemetry)
+        if telemetry:
+            gl = telemetry["goodput"]
+            lost = ", ".join(f"{c}={s:.3f}s" for c, s in
+                             sorted(gl.lost_seconds().items())) or "none"
+            print(f"goodput {gl.goodput():.4f}  "
+                  f"productive {gl.productive_seconds():.3f}s  lost: {lost}")
     else:
         for step in range(args.steps):
             ts, out = trainer.train_step(ts, batch, rng=jax.random.key(step))

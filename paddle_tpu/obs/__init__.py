@@ -21,6 +21,13 @@ The layer every serving subsystem reports through:
   resilience events plus an engine state snapshot, dumped as a
   postmortem JSON bundle on watchdog stall, SLO burn, drain timeout,
   or engine-loop crash.
+- `goodput` — GoodputLedger (training goodput + per-cause lost time
+  off the resilience event stream) and MFUMeter / analytic FLOPs
+  helpers for `ptpu_train_mfu`.
+- `devicemem` — DeviceMemoryMonitor: per-device HBM in-use and peak
+  gauges, live-buffer fallback on CPU.
+- `straggler` — StragglerDetector: cross-worker input-stall blame and
+  step-time dispersion over scraped worker expositions.
 
 ServeEngine / Scheduler / PagedKVCache and the resilience runtime
 record into `default_registry()` unless constructed with an explicit
@@ -51,6 +58,15 @@ from paddle_tpu.obs.fleetmetrics import (
     parse_exposition,
 )
 from paddle_tpu.obs.flightrec import FlightRecorder
+from paddle_tpu.obs.goodput import (
+    GoodputLedger,
+    MFUMeter,
+    causal_lm_step_flops,
+    param_count,
+    resolve_peak_flops,
+)
+from paddle_tpu.obs.devicemem import DeviceMemoryMonitor
+from paddle_tpu.obs.straggler import StragglerDetector
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram",
@@ -60,4 +76,7 @@ __all__ = [
     "SLOMonitor", "SLOObjective", "default_objectives",
     "counter_totals", "federate", "histogram_buckets", "parse_exposition",
     "FlightRecorder",
+    "GoodputLedger", "MFUMeter", "causal_lm_step_flops", "param_count",
+    "resolve_peak_flops",
+    "DeviceMemoryMonitor", "StragglerDetector",
 ]

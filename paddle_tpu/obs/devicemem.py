@@ -1,0 +1,102 @@
+"""Device (HBM) memory telemetry.
+
+Polls per-device memory into two gauge families so a training scrape
+shows the footprint and the high-water mark the way a replica scrape
+shows KV occupancy:
+
+- `ptpu_hbm_bytes_in_use{device=}` — current allocated bytes;
+- `ptpu_hbm_peak_bytes{device=}` — peak watermark.
+
+Source of truth is the runtime's own `Device.memory_stats()` when the
+backend implements it (TPU/GPU: `bytes_in_use`, `peak_bytes_in_use`).
+CPU backends generally don't, so the monitor degrades to summing the
+live `jax.Array` buffers per device (`jax.live_arrays()`) and tracks
+its own peak across samples — the gauges stay populated, just from
+host-side accounting instead of allocator truth. `sample()` is an
+explicit poll (cheap, no device sync); callers decide the cadence.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
+
+
+def _stats_for(dev) -> Optional[Dict[str, float]]:
+    fn = getattr(dev, "memory_stats", None)
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+    except Exception:
+        return None
+    if not stats or "bytes_in_use" not in stats:
+        return None
+    return stats
+
+
+def _live_bytes_by_device() -> Dict[object, int]:
+    totals: Dict[object, int] = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return totals
+    for arr in arrays:
+        try:
+            for shard in arr.addressable_shards:
+                dev = shard.device
+                nbytes = getattr(shard.data, "nbytes", 0)
+                totals[dev] = totals.get(dev, 0) + int(nbytes)
+        except Exception:
+            continue
+    return totals
+
+
+class DeviceMemoryMonitor:
+    """Per-device HBM gauges with allocator stats when available and a
+    live-buffer fallback otherwise."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 devices=None):
+        reg = registry if registry is not None else default_registry()
+        self._g_bytes = reg.gauge(
+            "ptpu_hbm_bytes_in_use",
+            "Current allocated device memory bytes",
+            labelnames=("device",))
+        self._g_peak = reg.gauge(
+            "ptpu_hbm_peak_bytes",
+            "Peak allocated device memory bytes seen",
+            labelnames=("device",))
+        self._devices = list(devices) if devices is not None \
+            else list(jax.local_devices())
+        self._own_peak: Dict[str, float] = {}
+        #: True once any sampled device reported allocator stats
+        self.allocator_backed = False
+
+    def sample(self) -> Dict[str, Dict[str, float]]:
+        """Poll every device; update gauges; return
+        {device_label: {"bytes_in_use": .., "peak_bytes": ..}}."""
+        live = None
+        out: Dict[str, Dict[str, float]] = {}
+        for dev in self._devices:
+            label = f"d{dev.id}"
+            stats = _stats_for(dev)
+            if stats is not None:
+                self.allocator_backed = True
+                in_use = float(stats["bytes_in_use"])
+                peak = float(stats.get("peak_bytes_in_use", in_use))
+            else:
+                if live is None:
+                    live = _live_bytes_by_device()
+                in_use = float(live.get(dev, 0))
+                peak = max(self._own_peak.get(label, 0.0), in_use)
+            self._own_peak[label] = max(self._own_peak.get(label, 0.0),
+                                        peak)
+            peak = self._own_peak[label]
+            self._g_bytes.labels(device=label).set(in_use)
+            self._g_peak.labels(device=label).set(peak)
+            out[label] = {"bytes_in_use": in_use, "peak_bytes": peak}
+        return out

@@ -83,6 +83,10 @@ class FlightRecorder:
             remove_event_tap(self._tap)
             self._installed = False
 
+    @property
+    def installed(self) -> bool:
+        return self._installed
+
     def __enter__(self) -> "FlightRecorder":
         return self.install()
 

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.parallel.compat import shard_map
+
 AxisName = Union[str, Tuple[str, ...]]
 
 
@@ -99,6 +101,6 @@ def shard_fn(mesh: Mesh, in_specs, out_specs,
     attention and the sharded embedding.
     """
     def deco(fn):
-        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=check_vma)
     return deco

@@ -1,0 +1,38 @@
+"""Version-compat shims for the jax surfaces the parallel layer uses.
+
+`shard_map` has moved across the jax releases this repo supports: it
+started life as `jax.experimental.shard_map.shard_map(...,
+check_rep=)` and later graduated to the top-level `jax.shard_map(...,
+check_vma=)` (the replication check was renamed when varying-manual-axes
+tracking replaced the rep-set analysis). Every call site in paddle_tpu
+writes the NEW spelling (keyword `check_vma`); this shim maps it onto
+whatever the installed jax actually provides, so the parallel suite
+does not die with `AttributeError: module 'jax' has no attribute
+'shard_map'` on a jax that predates the graduation.
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: True when the installed jax has the graduated top-level API with
+#: varying-manual-axes tracking. Legacy `experimental.shard_map` runs
+#: the simple collective programs (dp/tp MLP paths) but rejects the
+#: pipeline layer's transpose/vma programs and lacks `lax.pcast`, so
+#: tests for those features key off this flag to skip-with-reason.
+HAS_MODERN_SHARD_MAP = hasattr(jax, "shard_map")
+
+if HAS_MODERN_SHARD_MAP:
+    # modern jax: top-level API, `check_vma` keyword
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+else:
+    # pre-graduation jax: experimental module, `check_rep` keyword.
+    # check_rep is the same contract under its old name (validate that
+    # out_specs only claim replication the body actually establishes).
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)

@@ -112,7 +112,7 @@ class ShardedEmbedding(Module):
         return out
 
     def _shard_map_lookup(self, table, ids):
-        from jax import shard_map
+        from paddle_tpu.parallel.compat import shard_map
 
         mesh, axis = self.mesh, self.axis
         batch_axes = tuple(a for a in self.batch_axes if a in mesh.shape

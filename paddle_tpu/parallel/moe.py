@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.parallel.compat import shard_map
+
 Pytree = Any
 
 
@@ -110,7 +112,7 @@ def moe_ffn(params: Dict[str, jax.Array], x: jax.Array,
                 y = y + w * _expert_ffn(w1_l[j], w2_l[j], x_full * m)
             return lax.psum(y, axis)
 
-        y = jax.shard_map(
+        y = shard_map(
             local, mesh=mesh,
             in_specs=(P(axis, None, None), P(axis, None, None),
                       P(), P(), P()),
@@ -202,7 +204,7 @@ def moe_ffn_a2a(params: Dict[str, jax.Array], x: jax.Array, mesh: Mesh,
         dropped = jnp.mean(1.0 - keep.astype(jnp.float32))
         return y_l, probs, top_i[:, 0], dropped[None]
 
-    y, probs, idx, dropped = jax.shard_map(
+    y, probs, idx, dropped = shard_map(
         local, mesh=mesh,
         in_specs=(P(), P(axis, None, None), P(axis, None, None),
                   P(axis, None)),

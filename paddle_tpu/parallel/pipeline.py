@@ -42,6 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel.compat import shard_map
 from paddle_tpu.core.module import Context, Module, PARAMS
 
 Pytree = Any
@@ -154,7 +155,7 @@ def pipeline_apply(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
 
     in_specs = (P(axis), P(None, axis))   # params by stage; xs strided
     out_specs = P()
-    return jax.shard_map(local, mesh=mesh,
+    return shard_map(local, mesh=mesh,
                          in_specs=in_specs, out_specs=out_specs,
                          check_vma=False)(stacked_params, xs_str)
 
@@ -257,7 +258,7 @@ def pipeline_stream(stage_fn: Callable[[Pytree, jax.Array], jax.Array],
 
         in_specs = (param_specs if param_specs is not None else P(axis),
                     P(), data_spec(xs_str), data_spec(ys_str))
-        return jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+        return shard_map(local, mesh=mesh, in_specs=in_specs,
                              out_specs=P(), check_vma=False)(
                                  stacked_params, aux_params, xs_str, ys_str)
     return fn
@@ -503,7 +504,7 @@ def pipeline_stream_1f1b(stage_fn: Callable,
 
         xs_spec = data_spec(xs_str)
         pspec = param_specs if param_specs is not None else P(axis)
-        loss, dp, da, dxs_str = jax.shard_map(
+        loss, dp, da, dxs_str = shard_map(
             local, mesh=mesh,
             in_specs=(pspec, P(), xs_spec, data_spec(ys_str)),
             out_specs=(P(), pspec, P(), xs_spec),

@@ -23,6 +23,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from paddle_tpu.parallel.compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -105,7 +107,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         return ring_attention_inner(q_l, k_l, v_l, axis, sp,
                                     scale=scale, causal=causal)
 
-    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
@@ -444,7 +446,7 @@ def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         ring_core.defvjp(_ring_fwd_rule, _ring_bwd_rule)
         return ring_core(q_l, k_l, v_l)
 
-    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)
 
 
@@ -501,5 +503,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         o = _from_bhtd(o, b, hh)
         return heads_to_seq(o)          # [B, T/sp, H, D]
 
-    return jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, check_vma=False)(q, k, v)

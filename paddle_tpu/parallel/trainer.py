@@ -22,6 +22,7 @@ the mesh is the only thing that changes.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -67,6 +68,37 @@ class MeshTrainer:
         self._eval_step = None
         self._state_shardings = None
         self._consecutive_bad = 0  # bad-step guard budget tracking
+        # training telemetry families (None until enable_metrics())
+        self._m_phase = None
+        self._m_step = None
+        self._g_compiles = None
+        self._c_steps = None
+
+    # -- telemetry --------------------------------------------------------
+    def enable_metrics(self, registry=None) -> None:
+        """Register the step-phase telemetry families and start timing.
+
+        Instrumented steps host-sync once per step (block_until_ready on
+        the fetches) so the `wait` phase is the real device time rather
+        than async-dispatch noise — guard mode pays that sync anyway for
+        the bad-step decision, and a scrapeable step clock is the point
+        of turning this on. Leave metrics off to keep fully async
+        dispatch.
+        """
+        from paddle_tpu.obs.metrics import default_registry
+        reg = registry if registry is not None else default_registry()
+        self._m_phase = reg.histogram(
+            "ptpu_train_phase_ms",
+            "Host wall time per training step phase",
+            labelnames=("phase",))
+        self._m_step = reg.histogram(
+            "ptpu_train_step_ms",
+            "Host wall time of one train_step call, dispatch to sync")
+        self._g_compiles = reg.gauge(
+            "ptpu_train_compiles",
+            "Compiled executables in the train-step jit cache")
+        self._c_steps = reg.counter(
+            "ptpu_train_steps_total", "Completed train_step calls")
 
     # -- sharding helpers -------------------------------------------------
     def batch_sharding(self, leaf=None) -> NamedSharding:
@@ -225,17 +257,35 @@ class MeshTrainer:
     def put_batch(self, batch) -> Pytree:
         """Device-put a host batch with batch-axis sharding (the feed path;
         ≈ DataFeeder splitting a batch across places)."""
-        return jax.tree.map(
+        t0 = time.perf_counter()
+        out = jax.tree.map(
             lambda x, s: jax.device_put(x, s), batch,
             self._batch_shardings(batch))
+        if self._m_phase is not None:
+            # block so the observed h2d phase is the real transfer, not
+            # the async enqueue (the step blocks on the batch regardless)
+            jax.block_until_ready(out)
+            self._m_phase.labels(phase="h2d").observe(
+                (time.perf_counter() - t0) * 1e3)
+        return out
 
     def train_step(self, ts: TrainState, batch, rng=None):
         if self._state_shardings is None:
             raise RuntimeError("call init_state() first")
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        t0 = time.perf_counter()
         with RecordEvent("MeshTrainer.train_step"), self.mesh:
             new_ts, fetches = self._train_step(ts, batch, rng)
+        if self._m_phase is not None:
+            t1 = time.perf_counter()
+            self._m_phase.labels(phase="dispatch").observe((t1 - t0) * 1e3)
+            jax.block_until_ready(fetches)
+            t2 = time.perf_counter()
+            self._m_phase.labels(phase="wait").observe((t2 - t1) * 1e3)
+            self._m_step.observe((t2 - t0) * 1e3)
+            self._g_compiles.set(self._train_step._cache_size())
+            self._c_steps.inc()
         hint = getattr(ts, "_step_hint", None)
         budget = self.strategy.bad_step_budget
         if budget is not None:

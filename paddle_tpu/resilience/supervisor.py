@@ -23,6 +23,7 @@ itself handles what a TPU fleet does to a multi-hour job —
 
 from __future__ import annotations
 
+import contextlib
 import os
 import signal
 import sys
@@ -225,7 +226,10 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
                     supervisor: Optional[RunSupervisor] = None,
                     rng_for_step: Optional[Callable[[int], Any]] = None,
                     on_step: Optional[Callable[[int, Dict], None]] = None,
-                    max_rollbacks: int = 8) -> Pytree:
+                    max_rollbacks: int = 8,
+                    registry=None, goodput=None, flops_per_step=None,
+                    flight_recorder=None, memory_monitor=None,
+                    memory_sample_every: int = 1) -> Pytree:
     """Fault-tolerant step loop over `batch_for(global_step)`.
 
     The global step only advances on a FINITE step: a skipped bad step
@@ -235,21 +239,76 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
     the loop there. Chaos hooks (`maybe_sigterm`, `poison_batch`) are
     threaded through so the whole loop is testable under injection; they
     are no-ops unless armed via PTPU_CHAOS_*.
+
+    Telemetry (all optional, off by default):
+    - `registry` turns on the trainer's step-phase families plus
+      `ptpu_train_input_wait_ms` timed around `batch_for` — the signal
+      straggler blame keys on (a dp collective hides a slow worker's
+      step time, not its input stall).
+    - `goodput` (obs.goodput.GoodputLedger) wraps every step attempt
+      in an attribution window and charges checkpoint saves / rollback
+      restores as explicit pauses; installed here if not already.
+    - `flops_per_step` feeds an obs.goodput.MFUMeter with productive
+      step wall time (`ptpu_train_mfu`; silently absent on platforms
+      with unknown peak).
+    - `flight_recorder` (obs.FlightRecorder) is installed and mounted
+      on the supervisor's hang hook so a wedged step dumps a bundle
+      naming the stuck step, like a wedged serve loop.
+    - `memory_monitor` (obs.DeviceMemoryMonitor) is sampled every
+      `memory_sample_every` completed steps.
     """
     own_sup = supervisor is None
     sup = supervisor or RunSupervisor(manager)
     if own_sup:
         sup.install()
+    h_input = None
+    if registry is not None:
+        enable = getattr(trainer, "enable_metrics", None)
+        if enable is not None:
+            enable(registry)
+        h_input = registry.histogram(
+            "ptpu_train_input_wait_ms",
+            "Host wall time producing the step's input batch")
+    mfu = None
+    if flops_per_step:
+        from paddle_tpu.obs.goodput import MFUMeter
+        mfu = MFUMeter(flops_per_step, registry=registry)
+    own_goodput = goodput is not None and not goodput.installed
+    if own_goodput:
+        goodput.install()
+    own_rec = flight_recorder is not None and not flight_recorder.installed
+    if own_rec:
+        flight_recorder.install()
+    if flight_recorder is not None and sup.on_hang is None:
+        # the hang hook is the postmortem mount point: the bundle names
+        # the stuck step the same way a wedged serve loop's does
+        def _dump_hang(step, elapsed, _rec=flight_recorder):
+            _rec.dump("watchdog_hang", step=step,
+                      elapsed_s=round(elapsed, 3))
+        sup.on_hang = _dump_hang
+
+    def _pause(cause):
+        if goodput is not None:
+            return goodput.pause(cause)
+        return contextlib.nullcontext()
+
     rollbacks = 0
     step = start_step
     try:
         while step < total_steps:
             chaos.maybe_sigterm(step)
             sup.maybe_preempt_exit(ts, step)
-            batch = chaos.poison_batch(batch_for(step), step)
+            t_in = time.perf_counter()
+            raw = batch_for(step)
+            if h_input is not None:
+                h_input.observe((time.perf_counter() - t_in) * 1e3)
+            batch = chaos.poison_batch(raw, step)
             rng = rng_for_step(step) if rng_for_step is not None else None
+            window = (goodput.attempt() if goodput is not None
+                      else contextlib.nullcontext())
+            t0 = time.perf_counter()
             try:
-                with sup.watch_step(step):
+                with window, sup.watch_step(step):
                     ts, fetches = trainer.train_step(ts, batch, rng=rng)
             except BadStepBudgetExceeded as e:
                 rollbacks += 1
@@ -258,7 +317,8 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
                 target = getattr(e, "state", None)
                 if target is None:
                     target = ts
-                restored, rstep = manager.restore_latest(target)
+                with _pause("rollback"):
+                    restored, rstep = manager.restore_latest(target)
                 if restored is None:
                     raise
                 _ROLLBACKS.inc()
@@ -269,18 +329,35 @@ def train_resilient(trainer, ts: Pytree, batch_for: Callable[[int], Any],
                 if reset is not None:
                     reset()
                 continue
+            except Exception as e:
+                if flight_recorder is not None:
+                    flight_recorder.dump("train_crash", step=step,
+                                         error=repr(e))
+                raise
             if fetches.pop("bad_step", False):
                 _BAD_STEPS.inc()
                 continue  # update was skipped in-graph; retry this step
+            if mfu is not None:
+                mfu.observe_step(time.perf_counter() - t0)
             if on_step is not None:
                 on_step(step, fetches)
             step += 1
+            if memory_monitor is not None and memory_sample_every \
+                    and step % memory_sample_every == 0:
+                memory_monitor.sample()
             if save_every and step % save_every == 0:
-                manager.save(ts, step=step)
+                with _pause("checkpoint"):
+                    manager.save(ts, step=step)
         if save_every and total_steps % save_every != 0:
-            manager.save(ts, step=total_steps)
-        manager.wait()
+            with _pause("checkpoint"):
+                manager.save(ts, step=total_steps)
+        with _pause("checkpoint"):
+            manager.wait()
         return ts
     finally:
+        if own_rec:
+            flight_recorder.uninstall()
+        if own_goodput:
+            goodput.uninstall()
         if own_sup:
             sup.uninstall()

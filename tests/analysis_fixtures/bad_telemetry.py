@@ -22,6 +22,12 @@ class Instrumented:
         self._m_beta = registry.counter("ptpu_fix_beta_total", "b")
         self._m_left = registry.gauge("ptpu_fix_left", "l")
         self._m_right = registry.gauge("ptpu_fix_right", "r")
+        self._m_lost = registry.counter(
+            "ptpu_fix_lost_seconds_total", "lost", labelnames=("cause",))
+        self._m_hbm = registry.gauge(
+            "ptpu_fix_hbm_bytes", "hbm", labelnames=("device",))
+        self._m_strag = registry.gauge(
+            "ptpu_fix_straggler", "strag", labelnames=("worker",))
 
     def record(self, req):
         self._m_ok.labels(reason=f"c-{req.addr}").inc()  # expect: TS004

@@ -23,3 +23,34 @@ class Instrumented:
         self._m_lat.observe(ms)
         emit_event("serve", "finished", reason=reason)
         serve_event("finished_too", reason=reason)
+
+
+class TrainingInstrumented:
+    """The training-telemetry registration idioms (goodput / devicemem
+    / straggler): per-cause and per-device label sets, with computed
+    label values assigned to a variable BEFORE .labels() (TS004-safe
+    — the f-string never appears inside the call)."""
+
+    def __init__(self, registry):
+        self._c_lost = registry.counter(
+            "ptpu_fix_lost_seconds_total", "lost time by cause",
+            labelnames=("cause",))
+        self._g_hbm = registry.gauge(
+            "ptpu_fix_hbm_bytes", "per-device bytes",
+            labelnames=("device",))
+        self._g_strag = registry.gauge(
+            "ptpu_fix_straggler", "1 when flagged",
+            labelnames=("worker",))
+
+    def charge(self, cause, seconds):
+        # event-derived cause strings come from a closed severity list
+        self._c_lost.labels(cause=cause).inc(seconds)
+
+    def sample(self, devices):
+        for dev in devices:
+            label = f"d{dev.id}"  # computed ONCE, then a plain variable
+            self._g_hbm.labels(device=label).set(dev.bytes_in_use)
+
+    def flag(self, workers):
+        for worker, slow in workers.items():
+            self._g_strag.labels(worker=worker).set(1.0 if slow else 0.0)

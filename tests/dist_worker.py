@@ -12,6 +12,14 @@ Phases:
    processes, global batch assembled from per-process local shards.
 
 Prints ONE json line: {"proc":, "nprocs":, "ndev":, "psum":, "losses":}.
+
+Metrics mode (`PTPU_WORKER_METRICS=1`): each process additionally
+serves its training telemetry on a live MetricsServer, self-scrapes
+`/metrics` over HTTP, and embeds the exposition body in the JSON line
+(json.dumps keeps it one line) so the parent can run straggler
+detection over real per-worker scrape bodies. `PTPU_WORKER_SLOW_PROC`
+names the process whose input pipeline sleeps `PTPU_WORKER_SLOW_MS`
+per step — the deliberate straggler.
 """
 
 import json
@@ -75,13 +83,46 @@ def main():
     y = jax.make_array_from_process_local_data(
         bsh, gy[lo:lo + rows_per_proc])
 
+    out = {"proc": proc, "nprocs": nprocs, "ndev": ndev, "psum": psum}
+
+    metrics_mode = os.environ.get("PTPU_WORKER_METRICS") == "1"
+    reg = srv = h_input = None
+    slow_ms = 0.0
+    if metrics_mode:
+        import time
+        import urllib.request
+        from paddle_tpu.obs.http import MetricsServer
+        from paddle_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        trainer.enable_metrics(reg)
+        h_input = reg.histogram(
+            "ptpu_train_input_wait_ms",
+            "Host wall time producing the step's input batch")
+        if os.environ.get("PTPU_WORKER_SLOW_PROC") == str(proc):
+            slow_ms = float(os.environ.get("PTPU_WORKER_SLOW_MS", "30"))
+        srv = MetricsServer(reg).start()
+
     losses = []
-    for i in range(3):
+    steps = 6 if metrics_mode else 3
+    for i in range(steps):
+        if metrics_mode:
+            import time
+            t0 = time.perf_counter()
+            if slow_ms:
+                time.sleep(slow_ms / 1e3)   # the wedged input pipeline
+            h_input.observe((time.perf_counter() - t0) * 1e3)
         ts, fetches = trainer.train_step(ts, (x, y), rng=jax.random.key(i))
         losses.append(float(fetches["loss"]))
+    out["losses"] = losses
 
-    print(json.dumps({"proc": proc, "nprocs": nprocs, "ndev": ndev,
-                      "psum": psum, "losses": losses}))
+    if metrics_mode:
+        # scrape our own live /metrics endpoint — the parent gets the
+        # exact body a fleet aggregator would
+        with urllib.request.urlopen(srv.url, timeout=10) as resp:
+            out["exposition"] = resp.read().decode("utf-8")
+        srv.stop()
+
+    print(json.dumps(out))
     return 0
 
 

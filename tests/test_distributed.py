@@ -63,6 +63,56 @@ def test_matches_single_process():
     np.testing.assert_allclose(outs[0]["losses"], solo["losses"], atol=1e-5)
 
 
+def test_straggler_detection_two_workers():
+    """Tentpole acceptance: a deliberately slowed dp worker is surfaced
+    by the straggler gauge. Each worker serves live /metrics and
+    self-scrapes it; the parent runs StragglerDetector over the real
+    per-worker exposition bodies. The slow worker stalls its INPUT
+    pipeline — in lock-step SPMD its extra time bleeds into everyone's
+    step wall via the collectives, so blame must come from
+    ptpu_train_input_wait_ms, which stays local."""
+    from paddle_tpu.obs.metrics import MetricsRegistry
+    from paddle_tpu.obs.straggler import StragglerDetector
+
+    env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+           "PTPU_WORKER_METRICS": "1",
+           "PTPU_WORKER_SLOW_PROC": "1",
+           "PTPU_WORKER_SLOW_MS": "40"}
+    try:
+        results = launch(2, [sys.executable, WORKER],
+                         cpu_devices_per_proc=2, env=env, timeout=300)
+    except RuntimeError as e:
+        if "Multiprocess computations aren't implemented" in str(e):
+            pytest.skip("jaxlib build lacks multi-process CPU support")
+        raise
+    outs = []
+    for r in results:
+        line = [l for l in r.stdout.strip().splitlines()
+                if l.startswith("{")][-1]
+        outs.append(json.loads(line))
+    expositions = {}
+    for o in outs:
+        worker = f"w{o['proc']}"
+        assert "ptpu_train_step_ms" in o["exposition"]
+        assert "ptpu_train_input_wait_ms" in o["exposition"]
+        expositions[worker] = o["exposition"]
+
+    reg = MetricsRegistry()
+    det = StragglerDetector(registry=reg)
+    verdict = det.update(expositions)
+    assert verdict["w1"]["straggler"] is True
+    assert verdict["w0"]["straggler"] is False
+    assert verdict["w1"]["input_wait_ms"] > 10 * verdict["w0"]["input_wait_ms"]
+    g = reg.get("ptpu_train_straggler")
+    assert g.labels(worker="w1").value == 1.0
+    assert g.labels(worker="w0").value == 0.0
+    # lock-step check: both workers' step walls inflate together
+    assert reg.get("ptpu_train_step_dispersion").value < 3.0
+    # the fleet body merges the per-worker histograms exactly
+    fleet = det.fleet_exposition(expositions)
+    assert "ptpu_train_step_ms_count" in fleet
+
+
 def test_launcher_reports_failures():
     env = {"PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")}
     with pytest.raises(RuntimeError, match="boom|rc="):

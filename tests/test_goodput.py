@@ -1,0 +1,359 @@
+"""Training telemetry: step-phase histograms, goodput ledger, MFU,
+device-memory sampling, straggler detection, hang postmortems.
+
+The goodput tests reuse the chaos fixture pattern from test_chaos.py
+(deterministic fault schedules via PTPU_CHAOS_*, batches keyed by the
+global step) so the ledger's per-cause lost-time attribution can be
+reconciled EXACTLY against the resilience event stream a run prints.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.io.checkpoint import CheckpointManager
+from paddle_tpu.obs.devicemem import DeviceMemoryMonitor
+from paddle_tpu.obs.flightrec import FlightRecorder
+from paddle_tpu.obs.goodput import (
+    GoodputLedger, MFUMeter, causal_lm_step_flops, param_count,
+    resolve_peak_flops)
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.obs.straggler import StragglerDetector
+from paddle_tpu.resilience import chaos
+from paddle_tpu.resilience.supervisor import RunSupervisor, train_resilient
+from paddle_tpu.utils.log import add_event_tap, remove_event_tap
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    monkeypatch.setenv("PTPU_RETRY_SCALE", "0")
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _make(budget=None):
+    from paddle_tpu.core.executor import supervised_loss
+    from paddle_tpu.models import MLP
+    from paddle_tpu.ops import functional as F
+    from paddle_tpu.optim.optimizer import Adam
+    from paddle_tpu.parallel import (
+        DistStrategy, MeshConfig, MeshTrainer, make_mesh)
+
+    mesh = make_mesh(MeshConfig(dp=jax.device_count()))
+    model = MLP(hidden=(8,), num_classes=4)
+    loss_fn = supervised_loss(
+        lambda lg, y: F.softmax_with_cross_entropy(lg, y))
+    trainer = MeshTrainer(model, Adam(1e-2), loss_fn, mesh,
+                          strategy=DistStrategy(bad_step_budget=budget))
+    ts = trainer.init_state(jnp.zeros((16, 6)))
+    return trainer, ts
+
+
+def _batch_for(step):
+    rs = np.random.RandomState(1000 + step)
+    x = jnp.asarray(rs.randn(16, 6).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 4, 16).astype(np.int64))
+    return x, y
+
+
+# -- step-phase profiling ----------------------------------------------------
+
+def test_phase_histograms_and_compile_plateau(tmp_path):
+    reg = MetricsRegistry()
+    trainer, ts = _make()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=10)
+    train_resilient(trainer, ts, _batch_for, 5, mgr, registry=reg)
+
+    step_h = reg.get("ptpu_train_step_ms")
+    assert step_h is not None and step_h.count == 5
+    phase = reg.get("ptpu_train_phase_ms")
+    per_phase = {key[0]: child.count
+                 for key, child in phase.children().items()}
+    # dispatch + wait are timed inside train_step; h2d only on put_batch
+    assert per_phase["dispatch"] == 5
+    assert per_phase["wait"] == 5
+    # one executable for one (shape, dtype) stream: the compile gauge
+    # must plateau at 1 after warmup, not creep per step
+    assert reg.get("ptpu_train_compiles").value == 1
+    assert reg.get("ptpu_train_steps_total").value == 5
+    assert reg.get("ptpu_train_input_wait_ms").count == 5
+
+
+def test_put_batch_times_h2d_phase():
+    reg = MetricsRegistry()
+    trainer, _ = _make()
+    trainer.enable_metrics(reg)
+    trainer.put_batch(_batch_for(0))
+    phase = reg.get("ptpu_train_phase_ms")
+    h2d = phase.labels(phase="h2d")
+    assert h2d.count == 1 and h2d.sum >= 0.0
+
+
+# -- goodput ledger ----------------------------------------------------------
+
+def test_clean_run_goodput_near_one(tmp_path):
+    reg = MetricsRegistry()
+    gl = GoodputLedger(registry=reg)
+    trainer, ts = _make()
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=10)
+    train_resilient(trainer, ts, _batch_for, 5, mgr, save_every=0,
+                    goodput=gl)
+    assert not gl.installed  # train_resilient owns install/uninstall
+    assert gl.event_counts() == {}
+    assert gl.goodput() > 0.95
+    assert set(gl.lost_seconds()) <= {"checkpoint"}
+    assert gl.productive_seconds() > 0
+
+
+def test_chaos_goodput_reconciles_with_event_stream(tmp_path, monkeypatch):
+    monkeypatch.setenv("PTPU_CHAOS_NAN_STEP", "3")
+    monkeypatch.setenv("PTPU_CHAOS_NAN_ATTEMPTS", "3")
+    chaos.reload()
+
+    seen = {}
+
+    def _count(stream, rec):
+        if stream == "resilience":
+            evt = rec["evt"]
+            seen[evt] = seen.get(evt, 0) + 1
+
+    add_event_tap(_count)
+    reg = MetricsRegistry()
+    gl = GoodputLedger(registry=reg)
+    trainer, ts = _make(budget=2)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=10)
+    losses = {}
+    try:
+        train_resilient(
+            trainer, ts, _batch_for, 6, mgr, goodput=gl,
+            on_step=lambda s, f: losses.__setitem__(s, float(f["loss"])))
+    finally:
+        remove_event_tap(_count)
+
+    # the ledger is fed by the same tap hook: per-cause event counters
+    # must reconcile EXACTLY with the stream the run printed
+    assert gl.event_counts() == {k: float(v) for k, v in seen.items()}
+    assert seen == {"chaos_inject": 3, "bad_step_skip": 3, "rollback": 1}
+
+    lost = gl.lost_seconds()
+    # skipped attempts and the rollback restore both surface as lost
+    # time with their own cause; periodic saves as explicit pauses
+    assert {"bad_step_skip", "rollback", "checkpoint"} <= set(lost)
+    assert gl.goodput() < 1.0
+    # goodput is by definition productive / (productive + all lost)
+    p, l = gl.productive_seconds(), sum(lost.values())
+    assert gl.goodput() == pytest.approx(p / (p + l))
+    # and the run still converged on the fault-free curve's steps
+    assert sorted(losses) == list(range(6))
+
+
+def test_pause_and_attempt_windows_direct():
+    reg = MetricsRegistry()
+    gl = GoodputLedger(registry=reg)
+    with gl.attempt():
+        time.sleep(0.01)
+    with gl.pause("checkpoint"):
+        time.sleep(0.01)
+    assert gl.productive_seconds() >= 0.01
+    assert gl.lost_seconds()["checkpoint"] >= 0.01
+    assert 0.0 < gl.goodput() < 1.0
+
+
+# -- MFU / FLOPs accounting --------------------------------------------------
+
+def test_causal_lm_step_flops_hand_count():
+    # dense: 6 * (B*T) * params; attention: 6 * B * T^2 * D * L
+    flops = causal_lm_step_flops(batch_size=2, seq_len=8, d_model=16,
+                                 n_layers=2, n_params=1000)
+    assert flops == 6 * 2 * 8 * 1000 + 6 * 2 * 64 * 16 * 2
+    no_attn = causal_lm_step_flops(batch_size=2, seq_len=8, d_model=16,
+                                   n_layers=2, n_params=1000,
+                                   include_attention=False)
+    assert no_attn == 6 * 2 * 8 * 1000
+
+
+def test_param_count_matches_tree_leaves():
+    params = {"w": jnp.zeros((4, 3)), "b": jnp.zeros((3,))}
+    assert param_count(params) == 15
+
+
+def test_mfu_meter_math_and_ema():
+    reg = MetricsRegistry()
+    m = MFUMeter(1e9, peak_flops=1e12, registry=reg)
+    assert m.enabled
+    assert m.observe_step(0.01) == pytest.approx(0.1)  # 1e9/(0.01*1e12)
+    # EMA with alpha=0.25: 0.25*0.05 + 0.75*0.1
+    assert m.observe_step(0.02) == pytest.approx(0.0875)
+    assert reg.get("ptpu_train_mfu").value == pytest.approx(0.0875)
+
+
+def test_mfu_absent_when_peak_unknown(monkeypatch):
+    monkeypatch.delenv("PTPU_PEAK_FLOPS", raising=False)
+    reg = MetricsRegistry()
+    m = MFUMeter(1e9, registry=reg)  # CPU host: no peak table entry
+    if resolve_peak_flops() is None:
+        assert not m.enabled
+        assert reg.get("ptpu_train_mfu") is None  # cleanly absent
+        assert m.observe_step(0.01) is None
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("PTPU_PEAK_FLOPS", "2.5e12")
+    assert resolve_peak_flops() == 2.5e12
+    monkeypatch.setenv("PTPU_PEAK_FLOPS", "not-a-number")
+    # garbage override falls through to the platform table
+    assert resolve_peak_flops() == resolve_peak_flops(16)
+
+
+# -- device memory -----------------------------------------------------------
+
+def test_device_memory_monitor_graceful_on_any_backend():
+    reg = MetricsRegistry()
+    mon = DeviceMemoryMonitor(registry=reg)
+    keep = jnp.zeros((256, 256))  # something live to account
+    out = mon.sample()
+    assert isinstance(out, dict) and out
+    in_use = reg.get("ptpu_hbm_bytes_in_use")
+    peak = reg.get("ptpu_hbm_peak_bytes")
+    for fam in (in_use, peak):
+        assert fam is not None and fam.labelnames == ("device",)
+    d0 = f"d{jax.devices()[0].id}"
+    assert in_use.labels(device=d0).value >= keep.nbytes
+    assert peak.labels(device=d0).value >= in_use.labels(device=d0).value
+    # second sample never lowers the tracked peak
+    first_peak = peak.labels(device=d0).value
+    del keep
+    mon.sample()
+    assert peak.labels(device=d0).value >= first_peak
+
+
+# -- straggler detection -----------------------------------------------------
+
+def _worker_exposition(wait_ms, step_ms, n=8):
+    reg = MetricsRegistry()
+    h_wait = reg.histogram("ptpu_train_input_wait_ms", "input wait")
+    h_step = reg.histogram("ptpu_train_step_ms", "step wall")
+    for _ in range(n):
+        h_wait.observe(wait_ms)
+        h_step.observe(step_ms)
+    return reg.render_prometheus()
+
+
+def test_straggler_detector_flags_slow_worker():
+    reg = MetricsRegistry()
+    det = StragglerDetector(registry=reg)
+    # dp lock-step: step walls agree, the slow worker's input stall
+    # does not — blame keys on the wait family
+    out = det.update({
+        "w0": _worker_exposition(wait_ms=1.0, step_ms=20.0),
+        "w1": _worker_exposition(wait_ms=40.0, step_ms=21.0),
+    })
+    assert out["w1"]["straggler"] is True
+    assert out["w0"]["straggler"] is False
+    assert reg.get("ptpu_train_straggler").labels(worker="w1").value == 1.0
+    assert reg.get("ptpu_train_straggler").labels(worker="w0").value == 0.0
+    assert reg.get("ptpu_train_step_dispersion").value == pytest.approx(
+        21.0 / 20.0)
+
+
+def test_straggler_jitter_below_gap_not_flagged():
+    det = StragglerDetector(registry=MetricsRegistry())
+    # 3x ratio but only 2ms absolute gap: sub-min_gap_ms jitter between
+    # healthy workers must not trip the flag
+    out = det.update({
+        "w0": _worker_exposition(wait_ms=1.0, step_ms=20.0),
+        "w1": _worker_exposition(wait_ms=3.0, step_ms=20.0),
+    })
+    assert out["w1"]["straggler"] is False
+
+
+def test_straggler_median_baseline_three_workers():
+    det = StragglerDetector(registry=MetricsRegistry())
+    out = det.update({
+        "w0": _worker_exposition(wait_ms=2.0, step_ms=20.0),
+        "w1": _worker_exposition(wait_ms=3.0, step_ms=20.0),
+        "w2": _worker_exposition(wait_ms=50.0, step_ms=20.0),
+    })
+    assert [out[w]["straggler"] for w in ("w0", "w1", "w2")] == [
+        False, False, True]
+
+
+def test_straggler_fleet_exposition_merges_workers():
+    det = StragglerDetector(registry=MetricsRegistry())
+    body = det.fleet_exposition({
+        "w0": _worker_exposition(wait_ms=1.0, step_ms=20.0, n=3),
+        "w1": _worker_exposition(wait_ms=1.0, step_ms=20.0, n=5),
+    })
+    assert "ptpu_train_step_ms_count 8" in body
+
+
+# -- hang postmortem ---------------------------------------------------------
+
+class _SlowFirstStep:
+    """Delegating trainer whose FIRST train_step stalls long enough for
+    the watchdog to flag it — the wedged-collective stand-in."""
+
+    def __init__(self, inner, delay_s):
+        self._inner = inner
+        self._delay_s = delay_s
+        self._stalled = False
+
+    def train_step(self, ts, batch, rng=None):
+        if not self._stalled:
+            self._stalled = True
+            time.sleep(self._delay_s)
+        return self._inner.train_step(ts, batch, rng=rng)
+
+
+def test_watchdog_hang_dumps_flightrec_bundle(tmp_path):
+    reg = MetricsRegistry()
+    trainer, ts = _make()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    rec = FlightRecorder(streams=("resilience", "obs"),
+                         snapshot_fn=lambda: {"metrics": reg.snapshot()},
+                         out_dir=str(tmp_path / "flightrec"), registry=reg)
+    slow = _SlowFirstStep(trainer, delay_s=0.8)
+    with RunSupervisor(mgr, watchdog_timeout_s=0.2) as sup:
+        train_resilient(slow, ts, _batch_for, 3, mgr, supervisor=sup,
+                        registry=reg, flight_recorder=rec)
+        assert sup.hung_steps == [0]
+    paths = rec.dump_paths()
+    assert len(paths) == 1
+    with open(paths[0]) as f:
+        bundle = json.load(f)
+    # the bundle names the stuck step and carries the hang event +
+    # a metrics snapshot frozen at dump time
+    assert bundle["trigger"] == "watchdog_hang"
+    assert bundle["context"]["step"] == 0
+    assert bundle["context"]["elapsed_s"] >= 0.2
+    assert any(e.get("evt") == "hang" and e.get("step") == 0
+               for e in bundle["events"])
+    assert "metrics" in bundle["state"]
+
+
+def test_train_crash_dumps_flightrec_bundle(tmp_path):
+    reg = MetricsRegistry()
+    trainer, ts = _make()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=10)
+    rec = FlightRecorder(streams=("resilience", "obs"),
+                         out_dir=str(tmp_path / "flightrec"), registry=reg)
+
+    class _Boom:
+        def train_step(self, ts, batch, rng=None):
+            raise RuntimeError("xla went away")
+
+    with pytest.raises(RuntimeError, match="xla went away"):
+        train_resilient(_Boom(), ts, _batch_for, 3, mgr,
+                        flight_recorder=rec)
+    assert not rec.installed  # uninstalled on the way out
+    bundle = rec.last_bundle()
+    assert bundle["trigger"] == "train_crash"
+    assert bundle["context"]["step"] == 0
+    assert "xla went away" in bundle["context"]["error"]

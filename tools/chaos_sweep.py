@@ -140,11 +140,15 @@ def run_cluster_grid(tmp, steps):
 # -- in-process cells (always runnable) -------------------------------------
 
 def _inproc_run(ckpt, steps, budget=None):
+    """Returns (losses_by_step, GoodputLedger) — each cell gets a fresh
+    private registry so goodput/lost-time never bleed across cells."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.core.executor import supervised_loss
     from paddle_tpu.io.checkpoint import CheckpointManager
     from paddle_tpu.models import MLP
+    from paddle_tpu.obs.goodput import GoodputLedger
+    from paddle_tpu.obs.metrics import MetricsRegistry
     from paddle_tpu.ops import functional as F
     from paddle_tpu.optim.optimizer import Adam
     from paddle_tpu.parallel import (
@@ -170,16 +174,20 @@ def _inproc_run(ckpt, steps, budget=None):
                 jnp.asarray(rs.randint(0, 4, 16).astype(np.int64)))
 
     losses = {}
+    ledger = GoodputLedger(registry=MetricsRegistry())
     train_resilient(trainer, ts, batch_for, steps, mgr, start_step=start,
+                    goodput=ledger,
                     on_step=lambda s, f: losses.__setitem__(
                         s, float(f["loss"])))
-    return losses
+    return losses, ledger
 
 
 def run_inprocess_grid(tmp, steps):
     from paddle_tpu.resilience import chaos
 
-    clean = _inproc_run(os.path.join(tmp, "ip-clean"), steps)
+    clean, clean_ledger = _inproc_run(os.path.join(tmp, "ip-clean"), steps)
+    print(json.dumps({"cell": "ip:clean", "mode": "inprocess", "ok": True,
+                      "goodput": round(clean_ledger.goodput(), 4)}))
     mid, late = steps // 2, steps - 1
     grid = [
         (f"ip:nan@{mid}:{mid + 1}",
@@ -197,11 +205,16 @@ def run_inprocess_grid(tmp, steps):
         os.environ.update(env)
         chaos.reload()
         try:
-            losses = _inproc_run(
+            losses, ledger = _inproc_run(
                 os.path.join(tmp, name.replace(":", "-").replace("@", "-")),
                 steps, budget=budget)
             ok = losses == clean
-            verdict = {"cell": name, "mode": "inprocess", "ok": bool(ok)}
+            # goodput column: the fraction of tracked time the faulted
+            # cell spent on productive steps, plus where the rest went
+            verdict = {"cell": name, "mode": "inprocess", "ok": bool(ok),
+                       "goodput": round(ledger.goodput(), 4),
+                       "lost_s": {c: round(v, 4) for c, v in
+                                  sorted(ledger.lost_seconds().items())}}
         except Exception as e:  # a cell must never take the sweep down
             verdict = {"cell": name, "mode": "inprocess", "ok": False,
                        "error": f"{type(e).__name__}: {e}"}
