@@ -630,6 +630,12 @@ def _serving_bench(requests: int = 8, new_tokens: int = 32):
         "serve_ttft_p99_ms": round(ttft.quantile(0.99), 3),
         "serve_tpot_p99_ms": round(tpot.quantile(0.99), 3),
         "serve_spec_steps_per_token": round(decode_steps / max(gen, 1), 4),
+        # tensor-parallel serving columns (ISSUE 14): the bench engine
+        # runs tp=1 (CPU, single device); the columns exist so rig rows
+        # at tp>1 land in the same schema, and per-chip pool bytes is
+        # MEASURED off the pool arrays' addressable shards
+        "serve_tp_size": eng.tp_size,
+        "serve_kv_pool_bytes_per_chip": eng.cache.per_chip_pool_bytes(),
     }
 
 

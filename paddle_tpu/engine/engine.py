@@ -62,6 +62,7 @@ Two features ride that determinism with zero new compiled paths:
 
 from __future__ import annotations
 
+import functools
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -166,15 +167,60 @@ class ServeEngine:
                  registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[RequestTracer] = None,
                  host_tier_bytes: int = 0,
-                 kv_tier_int8: bool = False):
+                 kv_tier_int8: bool = False,
+                 tp_size: int = 1):
         self.model = model
-        self.variables = variables
         # telemetry (OBSERVABILITY.md): None -> the process registry /
         # a fresh tracer. serve_bench passes a private registry per
         # engine so its A/B cells don't pollute each other.
         self.obs = registry if registry is not None else default_registry()
         self.tracer = tracer if tracer is not None else RequestTracer()
         attn = model.blocks[0].attn
+        # tensor-parallel serving (ENGINE.md "Tensor-parallel serving"):
+        # tp_size > 1 builds a tp mesh over the first tp_size devices,
+        # shards the weights (parallel.sharding.serve_tp_rules) and KV
+        # pools over it, and pins the ONE compiled step's operand
+        # shardings — model code runs at GLOBAL shapes throughout, so
+        # tp=1 is exactly today's engine, bit for bit.
+        self.tp_size = int(tp_size)
+        self._serve_tp = None
+        self._mesh = None
+        if self.tp_size > 1:
+            from paddle_tpu.parallel.mesh import MeshConfig, make_mesh
+            from paddle_tpu.parallel.serve_collective import (ServeTP,
+                                                              resolve_mode)
+            from paddle_tpu.parallel.sharding import (serve_tp_rules,
+                                                      shard_variables)
+            devs = jax.devices()
+            if len(devs) < self.tp_size:
+                raise ValueError(
+                    f"tp_size={self.tp_size} needs that many devices, "
+                    f"have {len(devs)} — on CPU set XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=<n> before "
+                    "jax initializes (serve/replica.py --tp-size does "
+                    "this for you)")
+            if attn.num_heads % self.tp_size:
+                raise ValueError(
+                    f"num_heads={attn.num_heads} not divisible by "
+                    f"tp_size={self.tp_size}")
+            if attn.num_kv_heads % self.tp_size:
+                raise ValueError(
+                    f"num_kv_heads={attn.num_kv_heads} not divisible by "
+                    f"tp_size={self.tp_size}: KV pools shard over "
+                    "kv-heads so GQA groups stay device-local")
+            ffn_dim = model.blocks[0].ffn.fc1.features
+            if ffn_dim % self.tp_size:
+                raise ValueError(
+                    f"ffn_dim={ffn_dim} not divisible by "
+                    f"tp_size={self.tp_size}")
+            self._mesh = make_mesh(MeshConfig(tp=self.tp_size),
+                                   devices=devs[:self.tp_size])
+            self._serve_tp = ServeTP(self._mesh, self.tp_size,
+                                     mode=resolve_mode())
+            self._tp_rules = serve_tp_rules()
+            variables = shard_variables(self._mesh, variables,
+                                        self._tp_rules)
+        self.variables = variables
         self.max_seq_len = min(max_seq_len or model.max_len, model.max_len)
         self.max_batch_size = max_batch_size
         if max_prefill_tokens < 1:
@@ -234,7 +280,8 @@ class ServeEngine:
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
             head_dim=attn.head_dim, dtype=model.dtype,
             enable_prefix_cache=enable_prefix_cache, registry=self.obs,
-            host_tier=self.host_tier)
+            host_tier=self.host_tier, tp_size=self.tp_size,
+            mesh=self._mesh)
         if self.host_tier is not None:
             # prime the eager kernels tier traffic dispatches — the
             # demote gather (pool[block] device_get) and the revival
@@ -262,19 +309,60 @@ class ServeEngine:
         self.peak_occupancy = 0.0
         self.max_chunk_tokens = 0       # largest prefill step actually run
         self._register_metrics()
+        self._m_tp_size.set(float(self.tp_size))
+        if self._serve_tp is not None:
+            # one-shot collective microprobe at construction (host-side;
+            # the compiled step itself is never host-timed) — gives a
+            # scrape the fp-vs-int8 wire-cost comparison up front
+            from paddle_tpu.parallel.serve_collective import \
+                allreduce_probe_ms
+            self._allreduce_probe_ms = allreduce_probe_ms(
+                self._mesh, self._serve_tp.mode,
+                shape=(1, model.model_dim))
+            self._m_allreduce.labels(mode=self._serve_tp.mode).observe(
+                self._allreduce_probe_ms)
 
         model_ = model
+        serve_tp = self._serve_tp
 
-        @jax.jit
+        if serve_tp is None:
+            jit_step = jax.jit
+            jit_copy = jax.jit
+        else:
+            # pin the ONE compiled step's operand shardings so every
+            # call reuses the same executable (TP004 / the one-compile
+            # invariant): weights per serve_tp_rules, KV pools sharded
+            # over kv-heads, int32 packing operands replicated. Model
+            # code sees GLOBAL shapes; XLA partitions the ops, and the
+            # explicit islands (sharded attention, the quantized fc2
+            # reduce) run inside.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self._mesh, P())
+            pool_s = NamedSharding(self._mesh, P(None, None, "tp", None))
+            nl = len(model.blocks)
+            var_sh = self._tp_rules.tree_shardings(self._mesh,
+                                                   self.variables)
+            pools_sh = [(pool_s, pool_s)] * nl
+            jit_step = functools.partial(
+                jax.jit,
+                in_shardings=(var_sh, rep, rep, pools_sh, rep, rep, rep,
+                              rep, rep, rep, rep),
+                out_shardings=(rep, pools_sh))
+            jit_copy = functools.partial(
+                jax.jit,
+                in_shardings=(pools_sh, rep, rep),
+                out_shardings=pools_sh)
+
+        @jit_step
         def _step_fn(variables, tokens, positions, pools, block_tables,
                      context_lens, q_starts, tile_rows, tile_offs, slots,
                      last_idx):
             return model_.ragged_step_paged(
                 _fresh_cx(variables), tokens, positions, pools,
                 block_tables, context_lens, q_starts, tile_rows,
-                tile_offs, slots, last_idx)
+                tile_offs, slots, last_idx, tp=serve_tp)
 
-        @jax.jit
+        @jit_copy
         def _copy_blocks(pools, src, dst):
             # COW replay: dst blocks take src blocks' contents, every
             # layer; padding lanes are (0, 0) — scratch onto itself
@@ -381,6 +469,16 @@ class ServeEngine:
         self._m_spec_ratio = m.histogram(
             "ptpu_spec_acceptance_ratio",
             "Per-speculative-row accepted/drafted ratio")
+        # tensor-parallel serving (engine tp_size knob)
+        self._m_tp_size = m.gauge(
+            "ptpu_serve_tp_size",
+            "Tensor-parallel degree of the serving mesh (1 = "
+            "single-device)")
+        self._m_allreduce = m.histogram(
+            "ptpu_serve_allreduce_ms",
+            "Decode-MLP allreduce microprobe wall time at engine "
+            "construction (ms)",
+            labelnames=("mode",))        # mode=fp|int8
 
     def _on_admit(self, req: Request) -> None:
         """Scheduler hook: a request left the wait queue. Queue-wait is
@@ -907,6 +1005,14 @@ class ServeEngine:
         self.steps = 0
         self.obs.reset()
         self.tracer.reset()
+        # static-config series survive the zeroing: the tp degree and
+        # the construction-time collective microprobe describe this
+        # engine, not the traffic the reset is drawing a baseline for
+        # (the warmup path restores ptpu_engine_compiles the same way)
+        self._m_tp_size.set(float(self.tp_size))
+        if self._serve_tp is not None:
+            self._m_allreduce.labels(mode=self._serve_tp.mode).observe(
+                self._allreduce_probe_ms)
 
     # -- convenience --------------------------------------------------------
     def generate(self, prompts: List[List[int]], max_new_tokens: int = 32,

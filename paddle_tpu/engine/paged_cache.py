@@ -78,19 +78,39 @@ class PagedKVCache:
                  num_kv_heads: int, head_dim: int, dtype=jnp.float32,
                  enable_prefix_cache: bool = True,
                  registry: Optional[MetricsRegistry] = None,
-                 host_tier: Optional["HostKVTier"] = None):
+                 host_tier: Optional["HostKVTier"] = None,
+                 tp_size: int = 1, mesh=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
+        if tp_size < 1:
+            raise ValueError(f"tp_size {tp_size} < 1")
+        if num_kv_heads % tp_size != 0:
+            # fail at construction, not as a reshape crash mid-serve:
+            # the pool shards over kv-heads, so every chip must own a
+            # whole number of them (GQA groups stay device-local)
+            raise ValueError(
+                f"num_kv_heads={num_kv_heads} not divisible by "
+                f"tp_size={tp_size}: the KV pool shards over kv-heads "
+                "(pool_shape), so tp must divide them evenly")
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.num_kv_heads = num_kv_heads
         self.head_dim = head_dim
         self.dtype = dtype
+        self.tp_size = tp_size
         self.enable_prefix_cache = enable_prefix_cache
+        # pools are allocated at the GLOBAL shape; under tp the mesh
+        # shards the kv-head dim so each chip HOLDS pool_shape() bytes
         shape = (num_blocks, block_size, num_kv_heads, head_dim)
         self.pools: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
             (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
             for _ in range(num_layers)]
+        if mesh is not None and tp_size > 1:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ns = NamedSharding(mesh, P(None, None, "tp", None))
+            self.pools = [(jax.device_put(kp, ns), jax.device_put(vp, ns))
+                          for kp, vp in self.pools]
         # block 0 reserved for padded/dummy rows — never handed out
         self._free = deque(range(1, num_blocks))
         self._tables: Dict[int, List[int]] = {}
@@ -143,6 +163,36 @@ class PagedKVCache:
             "Prompt tokens served from the prefix cache")
 
     # -- capacity ---------------------------------------------------------
+    def pool_shape(self, tp_size: Optional[int] = None) -> Tuple[int, ...]:
+        """PER-CHIP shape of one k (or v) pool under `tp_size`-way
+        tensor parallelism (defaults to this cache's own tp_size): the
+        kv-head dim divides by tp, everything else replicates. tp=1 is
+        the global shape. Sizing math (engine HBM planning,
+        tools/paged_roofline.py --tp-size) goes through here so the
+        divisibility contract lives in ONE place."""
+        tp = self.tp_size if tp_size is None else tp_size
+        if tp < 1 or self.num_kv_heads % tp != 0:
+            raise ValueError(
+                f"num_kv_heads={self.num_kv_heads} not divisible by "
+                f"tp_size={tp}")
+        return (self.num_blocks, self.block_size,
+                self.num_kv_heads // tp, self.head_dim)
+
+    def per_chip_pool_bytes(self) -> int:
+        """Measured HBM bytes ONE chip holds across every layer's k+v
+        pool — read off the arrays' addressable shards, not computed,
+        so the serve_bench tp gate checks what XLA actually allocated.
+        Falls back to the full array size for unsharded pools."""
+        total = 0
+        for kp, vp in self.pools:
+            for arr in (kp, vp):
+                shards = getattr(arr, "addressable_shards", None)
+                if shards:
+                    total += max(s.data.nbytes for s in shards)
+                else:
+                    total += arr.nbytes
+        return total
+
     @property
     def free_blocks(self) -> int:
         return len(self._free)

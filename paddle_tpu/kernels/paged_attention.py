@@ -486,3 +486,67 @@ def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
     return _ragged_kernel_call(q, k_pool, v_pool, block_tables,
                                context_lens, q_starts, tile_rows, tile_offs,
                                scale, interpret)
+
+
+# -- tensor-parallel wrappers (engine tp_size knob, ENGINE.md) ------------
+#
+# The ragged kernel derives num_heads / num_kv_heads / groups from its
+# INPUT shapes, so it runs unmodified on per-shard slices: shard q over
+# heads and the pools over kv-heads on the "tp" mesh axis and each chip
+# computes attention for its own contiguous head block. With both H and
+# Hkv divisible by tp, shard s's q-head block [s·H/tp, (s+1)·H/tp) maps
+# exactly onto its kv-head block (the local `head // groups` lookup is
+# unchanged: groups = H/Hkv is the same locally), so GQA groups stay
+# device-local and NO collective runs inside attention. Block tables /
+# context lens / packing metadata are tiny int32 operands — replicated.
+
+
+def ragged_paged_attention_tp(mesh, q, k_pool, v_pool, block_tables,
+                              context_lens, q_starts, tile_rows, tile_offs,
+                              scale: Optional[float] = None,
+                              use_kernel: Optional[bool] = None,
+                              interpret: Optional[bool] = None):
+    """`ragged_paged_attention` as an explicit shard_map island over
+    the "tp" axis of `mesh` — q [T, H, D] sharded on H, pools sharded
+    on Hkv, everything else replicated; output [T, H, D] stays sharded
+    on H (the downstream out_proj is row-parallel over the same
+    axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.compat import shard_map
+
+    def body(q_, kp, vp, bt, cl, qs, tr, to):
+        return ragged_paged_attention(q_, kp, vp, bt, cl, qs, tr, to,
+                                      scale=scale, use_kernel=use_kernel,
+                                      interpret=interpret)
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P(None, "tp", None),
+                            P(None, None, "tp", None),
+                            P(None, None, "tp", None),
+                            P(), P(), P(), P(), P()),
+                  out_specs=P(None, "tp", None), check_vma=False)
+    return f(q, k_pool, v_pool, block_tables, context_lens, q_starts,
+             tile_rows, tile_offs)
+
+
+def paged_prefill_attention_tp(mesh, q, k_pool, v_pool, block_tables,
+                               context_lens, q_positions,
+                               scale: Optional[float] = None):
+    """`paged_prefill_attention` sharded the same way: q [B, C, H, D]
+    on H, pools on Hkv, int32 metadata replicated, output sharded on
+    H."""
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.compat import shard_map
+
+    def body(q_, kp, vp, bt, cl, qp):
+        return paged_prefill_attention(q_, kp, vp, bt, cl, qp, scale=scale)
+
+    f = shard_map(body, mesh=mesh,
+                  in_specs=(P(None, None, "tp", None),
+                            P(None, None, "tp", None),
+                            P(None, None, "tp", None),
+                            P(), P(), P()),
+                  out_specs=P(None, None, "tp", None), check_vma=False)
+    return f(q, k_pool, v_pool, block_tables, context_lens, q_positions)

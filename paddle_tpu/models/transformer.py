@@ -218,7 +218,8 @@ class MultiHeadAttention(Module):
         return out, (k_pool, v_pool)
 
     def prefill_chunk_paged(self, cx: Context, x, q_positions, k_pool,
-                            v_pool, block_tables, context_lens, slots):
+                            v_pool, block_tables, context_lens, slots,
+                            tp=None):
         """CHUNKED prefill through a paged KV cache (the serving path's
         suffix-only prefill). x: [B, C, D] — a window of each prompt,
         not necessarily starting at position 0 (prefix-cache hits skip
@@ -228,7 +229,12 @@ class MultiHeadAttention(Module):
         k/v is scattered into the pool FIRST, then every chunk query
         attends causally through the block table — over the cached
         prefix and the chunk itself in one go. Returns
-        (out [B, C, D], (new_k_pool, new_v_pool))."""
+        (out [B, C, D], (new_k_pool, new_v_pool)).
+
+        `tp` (parallel.serve_collective.ServeTP or None) routes the
+        attention through an explicit shard_map island over the mesh's
+        "tp" axis — heads/kv-heads device-local, metadata replicated;
+        the projections around it stay GSPMD ops at global shapes."""
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         if self.fused_qkv:
             b, t = x.shape[:2]
@@ -248,23 +254,29 @@ class MultiHeadAttention(Module):
             vh.reshape((-1,) + vh.shape[2:]).astype(v_pool.dtype)
         ).reshape(v_pool.shape)
         from paddle_tpu.kernels import paged_attention as paged
-        out = paged.paged_prefill_attention(qh, k_pool, v_pool,
-                                            block_tables, context_lens,
-                                            q_positions)   # [B, C, H, hd]
+        if tp is not None:
+            out = paged.paged_prefill_attention_tp(
+                tp.mesh, qh, k_pool, v_pool, block_tables, context_lens,
+                q_positions)                               # [B, C, H, hd]
+        else:
+            out = paged.paged_prefill_attention(
+                qh, k_pool, v_pool, block_tables, context_lens,
+                q_positions)                               # [B, C, H, hd]
         b, c = x.shape[:2]
         out = self.out_proj(cx, out.reshape(b, c, self.model_dim))
         return out, (k_pool, v_pool)
 
     def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots):
+                          tile_offs, slots, tp=None):
         """Mixed prefill+decode step over the FLAT ragged packing
         (kernels/paged_attention.py ragged_paged_attention): x: [T, D]
         — decode rows and prefill chunks packed into tile-aligned
         segments, no batch axis. The step's k/v is scattered into the
         pool at `slots` [T] first (pad positions land in scratch
         block 0), then one attention launch serves every row. Returns
-        (out [T, D], (new_k_pool, new_v_pool))."""
+        (out [T, D], (new_k_pool, new_v_pool)). `tp` routes attention
+        through the sharded island (see prefill_chunk_paged)."""
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         t = x.shape[0]
         if self.fused_qkv:
@@ -285,9 +297,14 @@ class MultiHeadAttention(Module):
         v_pool = v_pool.reshape(flat).at[slots].set(
             vh.astype(v_pool.dtype)).reshape(v_pool.shape)
         from paddle_tpu.kernels import paged_attention as paged
-        out = paged.ragged_paged_attention(
-            qh, k_pool, v_pool, block_tables, context_lens, q_starts,
-            tile_rows, tile_offs)                          # [T, H, hd]
+        if tp is not None:
+            out = paged.ragged_paged_attention_tp(
+                tp.mesh, qh, k_pool, v_pool, block_tables, context_lens,
+                q_starts, tile_rows, tile_offs)            # [T, H, hd]
+        else:
+            out = paged.ragged_paged_attention(
+                qh, k_pool, v_pool, block_tables, context_lens, q_starts,
+                tile_rows, tile_offs)                      # [T, H, hd]
         out = self.out_proj(cx, out.reshape(t, self.model_dim))
         return out, (k_pool, v_pool)
 
@@ -302,6 +319,32 @@ class FeedForward(Module):
 
     def forward(self, cx: Context, x):
         return self.fc2(cx, self.drop(cx, F.relu(self.fc1(cx, x))))
+
+    def forward_serve_tp(self, cx: Context, x, tp):
+        """Megatron column-then-row MLP for the tensor-parallel serve
+        step: fc1 runs as a GSPMD op with its weight column-sharded
+        (activations come out feature-sharded, no collective), and the
+        fc2 contraction is an explicit row-parallel island whose ONE
+        allreduce uses the serving collective (int8-quantized wire by
+        default, `PTPU_SERVE_ALLREDUCE=fp` for exact parity). The fc2
+        bias is added AFTER the reduce — inside the island it would be
+        summed tp times. Parameter paths are identical to forward()'s,
+        so tp serving reads the same variables tree."""
+        from paddle_tpu.parallel.serve_collective import row_parallel_matmul
+
+        cx = cx.scope(self._name or type(self).__name__)
+        h = self.drop(cx, F.relu(self.fc1(cx, x)))
+        fc2 = self.fc2
+        c2 = cx.scope(fc2._name or "fc2")
+        w = c2.param("weight", (h.shape[-1], fc2.features),
+                     fc2.kernel_init, fc2.param_dtype)
+        y = row_parallel_matmul(h.astype(fc2.dtype), w.astype(fc2.dtype),
+                                tp)
+        if fc2.use_bias:
+            b = c2.param("bias", (fc2.features,), fc2.bias_init,
+                         fc2.param_dtype)
+            y = y + b.astype(fc2.dtype)
+        return y
 
 
 class EncoderLayer(Module):
@@ -519,24 +562,29 @@ class CausalBlock(Module):
         return x, pools
 
     def prefill_chunk_paged(self, cx: Context, x, q_positions, k_pool,
-                            v_pool, block_tables, context_lens, slots):
+                            v_pool, block_tables, context_lens, slots,
+                            tp=None):
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         h, pools = self.attn.prefill_chunk_paged(
             cx, self.ln1(cx, x), q_positions, k_pool, v_pool,
-            block_tables, context_lens, slots)
+            block_tables, context_lens, slots, tp=tp)
         x = x + self.drop(cx, h)
-        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        f = (self.ffn.forward_serve_tp(cx, self.ln2(cx, x), tp)
+             if tp is not None else self.ffn(cx, self.ln2(cx, x)))
+        x = x + self.drop(cx, f)
         return x, pools
 
     def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots):
+                          tile_offs, slots, tp=None):
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         h, pools = self.attn.ragged_step_paged(
             cx, self.ln1(cx, x), k_pool, v_pool, block_tables,
-            context_lens, q_starts, tile_rows, tile_offs, slots)
+            context_lens, q_starts, tile_rows, tile_offs, slots, tp=tp)
         x = x + self.drop(cx, h)
-        x = x + self.drop(cx, self.ffn(cx, self.ln2(cx, x)))
+        f = (self.ffn.forward_serve_tp(cx, self.ln2(cx, x), tp)
+             if tp is not None else self.ffn(cx, self.ln2(cx, x)))
+        x = x + self.drop(cx, f)
         return x, pools
 
 
@@ -672,7 +720,8 @@ class CausalLM(Module):
         return self._head(cx, last_h)[:, 0], kvs
 
     def prefill_chunk_paged(self, cx: Context, tokens, start_pos, pools,
-                            block_tables, context_lens, slots, last_idx):
+                            block_tables, context_lens, slots, last_idx,
+                            tp=None):
         """Chunked/suffix-only prefill for paged serving: tokens [B, C]
         is ONE WINDOW of each prompt (right-padded; pad positions
         scatter to scratch slot 0), start_pos [B] int32 the absolute
@@ -697,7 +746,7 @@ class CausalLM(Module):
         for blk, (k_pool, v_pool) in zip(self.blocks, pools):
             x, np_ = blk.prefill_chunk_paged(cx, x, pos, k_pool, v_pool,
                                              block_tables, context_lens,
-                                             slots)
+                                             slots, tp=tp)
             new_pools.append(np_)
         hidden = self.ln_f(cx, x)
         idx = last_idx.astype(jnp.int32)[:, None, None]
@@ -707,7 +756,7 @@ class CausalLM(Module):
 
     def ragged_step_paged(self, cx: Context, tokens, positions, pools,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots, last_idx):
+                          tile_offs, slots, last_idx, tp=None):
         """ONE mixed prefill+decode serve step over the flat ragged
         packing — the engine's single compiled path. tokens [T] ids and
         positions [T] int32 are the flat packing (decode rows are
@@ -733,7 +782,7 @@ class CausalLM(Module):
             x, np_ = blk.ragged_step_paged(cx, x, k_pool, v_pool,
                                            block_tables, context_lens,
                                            q_starts, tile_rows, tile_offs,
-                                           slots)
+                                           slots, tp=tp)
             new_pools.append(np_)
         hidden = self.ln_f(cx, x)                                # [T, D]
         idx = last_idx.astype(jnp.int32)

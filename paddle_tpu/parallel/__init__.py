@@ -1,7 +1,7 @@
 from paddle_tpu.parallel.mesh import MeshConfig, make_mesh, local_mesh
 from paddle_tpu.parallel.strategy import DistStrategy, ReduceStrategy
 from paddle_tpu.parallel.sharding import (
-    ShardingRules, named_sharding, shard_variables,
+    ShardingRules, named_sharding, serve_tp_rules, shard_variables,
 )
 from paddle_tpu.parallel.trainer import MeshTrainer
 from paddle_tpu.parallel import collective

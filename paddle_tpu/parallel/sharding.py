@@ -129,3 +129,26 @@ def transformer_tp_rules(tp_axis: str = "tp",
         (r"embed[^/]*/weight$", (tp_axis, None)),
         (r"bias$", (None,)),
     ], fsdp_axis=fsdp_axis, fsdp_min_rank=2)
+
+
+def serve_tp_rules(tp_axis: str = "tp") -> ShardingRules:
+    """Megatron TP for the SERVING step (engine/engine.py tp_size knob).
+
+    Differs from transformer_tp_rules where serving constraints demand
+    it: embeddings and the LM head stay REPLICATED (the ragged step
+    gathers last_idx rows and samples host-side — a vocab-sharded head
+    would force an extra collective per step), column-parallel biases
+    shard WITH their features (fc1/qkv output columns live per-shard),
+    and row-parallel biases (fc2/out_proj) replicate — they are added
+    after the reduce, once. Attention q/k/v shard on the head dim
+    (head-major qkv packing keeps each head's q/k/v on one shard; KV
+    pools shard the same way, PagedKVCache pool_shape), out_proj is
+    row-parallel. Everything unmatched replicates (default=()).
+    """
+    return ShardingRules([
+        (r"(q_proj|k_proj|v_proj|qkv)/weight$", (None, tp_axis)),
+        (r"(q_proj|k_proj|v_proj|qkv)/bias$", (tp_axis,)),
+        (r"fc1/weight$", (None, tp_axis)),
+        (r"fc1/bias$", (tp_axis,)),
+        (r"(out_proj|fc2)/weight$", (tp_axis, None)),
+    ], default=())

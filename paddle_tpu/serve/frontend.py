@@ -215,6 +215,17 @@ class ServeFrontend:
     def start(self) -> "ServeFrontend":
         if self._server is not None:
             return self
+        # The engine loop must be LIVE before warmup: the engine is
+        # single-threaded, so the warmup request has to ride the loop
+        # like any other submission (stepping from this thread would
+        # race it once real traffic lands) — and under tensor-parallel
+        # serving the warmup compile IS the sharded step executable,
+        # so it must be built through the same path /readyz vouches
+        # for. Starting the loop first makes warmup() take its
+        # engine-loop branch instead of the direct-generate fallback.
+        self._engine_thread = threading.Thread(
+            target=self._engine_loop, daemon=True, name="ptpu-serve-engine")
+        self._engine_thread.start()
         if self._warmup:
             self.warmup()
         self.slo.start(self.slo_interval_s)
@@ -237,9 +248,6 @@ class ServeFrontend:
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]
-        self._engine_thread = threading.Thread(
-            target=self._engine_loop, daemon=True, name="ptpu-serve-engine")
-        self._engine_thread.start()
         self._serve_thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="ptpu-serve-http")

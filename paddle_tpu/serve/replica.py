@@ -57,6 +57,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-tier-int8", action="store_true",
                    help="store host-tier blocks int8-quantized "
                         "(roughly doubles the tier's effective budget)")
+    p.add_argument("--tp-size", type=int, default=1,
+                   help="tensor-parallel degree: shard the one compiled "
+                        "step over the first N devices (weights + KV "
+                        "pools; per-chip HBM ~1/N). On CPU the replica "
+                        "forces N virtual devices before jax initializes; "
+                        "PTPU_SERVE_ALLREDUCE=fp|int8 picks the decode "
+                        "collective wire format")
     # front-end / admission / drain
     p.add_argument("--max-queue-depth", type=int, default=64)
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
@@ -94,6 +101,23 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _ensure_device_visibility(tp_size: int) -> None:
+    """--tp-size needs tp_size visible devices. On a CPU host that
+    means the XLA virtual-device flag, which only takes effect if set
+    BEFORE jax initializes — which is why build_frontend defers every
+    jax import until after this runs (main() calls it first). A
+    no-op when the flag is already present (e.g. under the test
+    suite's conftest) or tp_size == 1."""
+    if tp_size <= 1:
+        return
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={tp_size}").strip()
+
+
 def build_frontend(a: argparse.Namespace):
     """Everything up to (not including) start(): importable by tests
     that want an in-process replica with CLI-identical wiring."""
@@ -111,7 +135,7 @@ def build_frontend(a: argparse.Namespace):
             enable_prefix_cache=not a.no_prefix_cache,
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
-            kv_tier_int8=a.kv_tier_int8)
+            kv_tier_int8=a.kv_tier_int8, tp_size=a.tp_size)
     else:
         import jax
         import jax.numpy as jnp
@@ -130,7 +154,7 @@ def build_frontend(a: argparse.Namespace):
             enable_prefix_cache=not a.no_prefix_cache,
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
-            kv_tier_int8=a.kv_tier_int8)
+            kv_tier_int8=a.kv_tier_int8, tp_size=a.tp_size)
     slo = SLOMonitor(
         registry,
         objectives=default_objectives(
@@ -156,6 +180,7 @@ def build_frontend(a: argparse.Namespace):
 
 def main(argv: Optional[List[str]] = None) -> int:
     a = build_parser().parse_args(argv)
+    _ensure_device_visibility(a.tp_size)
     frontend = build_frontend(a)
     frontend.start().install_signals()
     code = frontend.wait()      # blocks until a drain completes

@@ -26,6 +26,16 @@ E = (1-a^(K+1))/(1-a) tokens in expectation at per-token acceptance
 bytes_per_token / E, so the emitted-token ceiling scales by E. Output
 is unchanged when the flag is absent.
 
+`--tp-size N` models tensor-parallel serving (engine `tp_size` knob):
+the KV pool is sharded over kv-heads, so the per-chip pool and the
+per-chip streamed bytes/token both drop by N, lifting the per-chip
+decode ceiling by N — at the price of one decode-MLP allreduce per
+layer. The `ar_fp/ar_i8` columns price that collective's wire bytes
+per token (serve_collective.allreduce_wire_bytes: fp ring vs EQuARX
+int8 all-gather with per-256-chunk scales); it rides the ICI, not HBM,
+so it widens no HBM column but bounds how small a per-token step can
+shrink before the collective dominates.
+
 Default run is a CPU smoke: prints the analytic sweep and validates the
 ragged kernel end-to-end in interpret mode on one tiny cell (finite
 output, matches the XLA reference). `--rig` additionally times the
@@ -35,7 +45,7 @@ GB/s against --hbm-gbps.
 
 Run: python tools/paged_roofline.py [--rig] [--block-sizes 8,16,32]
      [--num-blocks 512,2048,8192] [--hbm-gb 16 --hbm-gbps 819]
-     [--spec-k 2,4,8 --spec-accept 0.7]
+     [--spec-k 2,4,8 --spec-accept 0.7] [--tp-size 2]
 """
 
 import argparse
@@ -167,6 +177,10 @@ def main():
     ap.add_argument("--spec-accept", type=float, default=0.7,
                     help="modelled per-token draft acceptance "
                     "probability for the --spec-k columns")
+    ap.add_argument("--tp-size", type=int, default=1,
+                    help="model tensor-parallel serving: per-chip "
+                    "pool/bytes columns (/N) plus the decode-MLP "
+                    "allreduce wire bytes per token, fp vs int8")
     args = ap.parse_args()
 
     if args.rig:
@@ -177,10 +191,26 @@ def main():
     spec_ks = ([int(s) for s in args.spec_k.split(",")]
                if args.spec_k else [])
     L, Hkv, Dh = args.layers, args.kv_heads, args.head_dim
+    tp = args.tp_size
+    if tp < 1 or Hkv % tp != 0 or args.heads % tp != 0:
+        raise SystemExit(
+            f"--tp-size {tp} must be >= 1 and divide both --heads "
+            f"{args.heads} and --kv-heads {Hkv} (the pool shards over "
+            f"kv-heads; GQA groups must stay device-local)")
 
     print(f"model: {L} layers, {args.heads} heads ({Hkv} kv), "
           f"head_dim {Dh}, bf16 pool; rig: {args.hbm_gb:.0f} GB HBM "
           f"@ {args.hbm_gbps:.0f} GB/s; batch {args.batch}")
+    if tp > 1:
+        from paddle_tpu.parallel.serve_collective import \
+            allreduce_wire_bytes
+        model_dim = args.heads * Dh
+        ar_fp = L * allreduce_wire_bytes(model_dim, "fp", tp)
+        ar_i8 = L * allreduce_wire_bytes(model_dim, "int8", tp)
+        print(f"tp={tp}: per-chip columns divide pool and streamed "
+              f"bytes by {tp}; decode-MLP allreduce "
+              f"{ar_fp/1e3:.2f} KB/tok fp vs {ar_i8/1e3:.2f} KB/tok "
+              f"int8 over ICI")
     if spec_ks:
         print(f"spec columns: emitted-token ceiling at per-token "
               f"acceptance {args.spec_accept:.2f} "
@@ -190,6 +220,9 @@ def main():
     hdr = (f"{'BS':>4} {'NB':>6} {'pool_gb':>8} {'%hbm':>6} "
            f"{'cap_tok':>8} {'ctx/row':>8} {'KB/tok':>8} "
            f"{'tok_s_ceil':>10}")
+    if tp > 1:
+        hdr += (f" {'chip_gb':>8} {'KB/t/chip':>9} {'ar_fp_KB':>8} "
+                f"{'ar_i8_KB':>8} {'tok_s_chip':>10}")
     for k in spec_ks:
         hdr += f" {f'spec_k={k}':>10}"
     if args.rig:
@@ -208,6 +241,13 @@ def main():
             line = (f"{bs:>4} {nb:>6} {pool/1e9:>8.3f} {frac*100:>5.1f}% "
                     f"{cap:>8} {ctx:>8} {bpt/1e3:>8.1f} "
                     f"{ceil_tok:>10.0f}")
+            if tp > 1:
+                # kv-head sharding: per-chip pool AND per-chip streamed
+                # bytes are exactly 1/tp of the replicated numbers, so
+                # the per-chip HBM decode ceiling scales by tp.
+                line += (f" {pool/tp/1e9:>8.3f} {bpt/tp/1e3:>9.1f} "
+                         f"{ar_fp/1e3:>8.2f} {ar_i8/1e3:>8.2f} "
+                         f"{args.hbm_gbps * 1e9 / (bpt / tp):>10.0f}")
             for k in spec_ks:
                 line += (f" {ceil_tok * expected_emitted(k, args.spec_accept):>10.0f}")
             if frac > 1.0:

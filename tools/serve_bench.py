@@ -1,6 +1,6 @@
 """Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
-Eight scenarios, each an acceptance property of the serving stack
+Nine scenarios, each an acceptance property of the serving stack
 (ENGINE.md / OBSERVABILITY.md). The in-process scenarios run on the
 SAME model with EXACT token identity (greedy decode — the engine's
 batching/sharing/chunking invariance makes identity, not closeness,
@@ -51,6 +51,14 @@ drives them over HTTP:
            tier; the int8 sub-cell is completion + revival gated —
            its round-trip is exact only to scale/127 per element).
            Cold/warm cells flush as measured.
+- tp:      tensor-parallel serving (ENGINE.md): the ONE ragged step
+           sharded over a 2-device CPU mesh (weights per
+           serve_tp_rules, KV pools over kv-heads) must stay
+           byte-identical to tp=1 in fp-allreduce mode, keep the
+           compile gauge at 1, and hold per-chip KV pool bytes to at
+           most half of tp=1's plus one block of slack; the
+           int8-quantized collective engine must complete the same
+           workload (identity reported informationally).
 - router:  the end-to-end scale-out story (serve/). Boots replica
            subprocesses (`python -m paddle_tpu.serve.replica`) with
            identical weights and a Router over them, then gates four
@@ -86,7 +94,7 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 Exit code: 0 iff every scenario's verdict holds.
 
 Run: python tools/serve_bench.py
-     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|router]
+     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|tp|router]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
      [--trace-out FILE]     # dump the last in-process verdict engine's
@@ -106,6 +114,16 @@ import sys
 import tempfile
 import threading
 import time
+
+# tp scenario: the CPU mesh needs >= 2 virtual devices, and XLA's
+# device-count flag only takes effect BEFORE jax initializes — which
+# `import _bootstrap` below does. Harmless for every other scenario
+# (tp=1 engines stay on device 0).
+if ("xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
 
 import _bootstrap  # noqa: F401  (repo path + cpu override)
 
@@ -757,6 +775,95 @@ def scenario_tiered(model, variables, args):
     return ok
 
 
+# -- scenario: tensor-parallel serving — sharded step, quantized wire ------
+
+def _run_tp_cell(model, variables, args, prompts, tp_size, mode):
+    """One engine at (tp_size, allreduce mode): serve the workload and
+    emit the measured cell immediately (the early-flush contract).
+    The collective mode is resolved from the env at engine
+    CONSTRUCTION, so it is pinned around make_engine and restored."""
+    prev = os.environ.get("PTPU_SERVE_ALLREDUCE")
+    os.environ["PTPU_SERVE_ALLREDUCE"] = mode
+    try:
+        eng = make_engine(model, variables, args, tp_size=tp_size)
+    finally:
+        if prev is None:
+            os.environ.pop("PTPU_SERVE_ALLREDUCE", None)
+        else:
+            os.environ["PTPU_SERVE_ALLREDUCE"] = prev
+    eng.generate([[args.vocab - 1] * 4], max_new_tokens=2)  # compile untimed
+    eng.reset_stats()
+    t0 = time.perf_counter()
+    outs = eng.generate(prompts, max_new_tokens=args.new_tokens)
+    wall = time.perf_counter() - t0
+    toks = int(eng.obs.get("ptpu_serve_tokens_total")
+               .labels(kind="generated").value)
+    per_chip = eng.cache.per_chip_pool_bytes()
+    compiles = int(eng._step_fn._cache_size())
+    eng.cache.assert_quiesced()
+    emit({"cell": f"tp{tp_size}_{mode}", "tp_size": tp_size,
+          "allreduce_mode": mode, "requests": len(prompts),
+          "generated_tokens": toks, "wall_s": round(wall, 3),
+          "tok_s": round(toks / max(wall, 1e-9), 2),
+          "kv_pool_bytes_per_chip": per_chip,
+          "compiles": compiles})
+    return {"eng": eng, "outs": outs, "per_chip": per_chip,
+            "compiles": compiles}
+
+
+def scenario_tp(model, variables, args):
+    """Tensor-parallel serving gate (ENGINE.md "Tensor-parallel
+    serving"): tp=2 on the CPU mesh in fp-allreduce mode must produce
+    token streams BYTE-IDENTICAL to tp=1 (greedy sampling reads integer
+    argmaxes, and the fp collective is lax.psum — exact up to reduction
+    order, which the argmax comparison absorbs), with the compile gauge
+    pinned at 1 and the per-chip KV pool at most half of tp=1's plus
+    one block of slack. The int8-collective engine is completion-gated
+    (its wire format is exact only to scale/127 per element; identity
+    is reported informationally)."""
+    global LAST_EXPOSITION, LAST_TRACER
+    import jax
+    if jax.device_count() < 2:
+        emit({"cell": "tp_verdict", "ok": False,
+              "error": f"need >= 2 devices, have {jax.device_count()} "
+                       "(XLA_FLAGS=--xla_force_host_platform_device_"
+                       "count was set too late?)"})
+        return False
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, args.vocab - 1,
+                            rng.integers(4, args.prompt_len + 1)).tolist()
+               for _ in range(args.requests)]
+    ref = _run_tp_cell(model, variables, args, prompts, 1, "fp")
+    fp = _run_tp_cell(model, variables, args, prompts, 2, "fp")
+    q = _run_tp_cell(model, variables, args, prompts, 2, "int8")
+    LAST_EXPOSITION = q["eng"].metrics_text()
+    LAST_TRACER = q["eng"].tracer
+    # one block of slack: a whole-pool byte count divided by the block
+    # count is exactly one block row (k+v, all layers)
+    slack = ref["per_chip"] // args.num_blocks
+    pool_halved = fp["per_chip"] <= ref["per_chip"] // 2 + slack
+    fp_identical = fp["outs"] == ref["outs"]
+    int8_complete = bool(
+        len(q["outs"]) == len(prompts)
+        and all(len(o) == len(r) > 0
+                for o, r in zip(q["outs"], ref["outs"])))
+    ok = bool(fp_identical and pool_halved and int8_complete
+              and ref["compiles"] == 1 and fp["compiles"] == 1
+              and q["compiles"] == 1)
+    emit({"cell": "tp_verdict", "ok": ok,
+          "tokens_identical_fp": bool(fp_identical),
+          "pool_per_chip_halved": bool(pool_halved),
+          "pool_bytes_per_chip_tp1": ref["per_chip"],
+          "pool_bytes_per_chip_tp2": fp["per_chip"],
+          "compiles_tp1": ref["compiles"],
+          "compiles_tp2_fp": fp["compiles"],
+          "compiles_tp2_int8": q["compiles"],
+          "int8_complete": int8_complete,
+          "int8_tokens_identical":
+              bool(q["outs"] == ref["outs"])})     # informational only
+    return ok
+
+
 # -- scenario: router — multi-replica scale-out over real processes --------
 
 # the replica CLI's default model (vocab 61, dim 16) boots in seconds;
@@ -1250,7 +1357,7 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
-                             "mixed", "spec", "nbest", "tiered",
+                             "mixed", "spec", "nbest", "tiered", "tp",
                              "router"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
@@ -1304,7 +1411,8 @@ def main():
     scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
                  "chunked": scenario_chunked, "mixed": scenario_mixed,
                  "spec": scenario_spec, "nbest": scenario_nbest,
-                 "tiered": scenario_tiered, "router": scenario_router}
+                 "tiered": scenario_tiered, "tp": scenario_tp,
+                 "router": scenario_router}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
