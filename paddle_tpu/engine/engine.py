@@ -168,6 +168,7 @@ class ServeEngine:
                  tracer: Optional[RequestTracer] = None,
                  host_tier_bytes: int = 0,
                  kv_tier_int8: bool = False,
+                 tier_spill_dir: Optional[str] = None,
                  tp_size: int = 1):
         self.model = model
         # telemetry (OBSERVABILITY.md): None -> the process registry /
@@ -275,6 +276,18 @@ class ServeEngine:
             HostKVTier(host_tier_bytes, int8=kv_tier_int8,
                        registry=self.obs)
             if host_tier_bytes > 0 else None)
+        # warm restart (RESILIENCE.md §fleet): a spill dir warm-starts
+        # the tier from the previous process's drain spill — the blocks
+        # are advertised on /kvprefixes again within one scrape
+        # interval, so the router's fleet directory finds them. A
+        # missing/partial/foreign spill loads 0 blocks and the tier
+        # simply starts cold.
+        self.tier_spill_dir = tier_spill_dir
+        if self.host_tier is not None and tier_spill_dir:
+            loaded = self.host_tier.load_spill(tier_spill_dir)
+            if loaded:
+                serve_event("tier_warm_start", dir=tier_spill_dir,
+                            blocks=loaded)
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
@@ -1010,6 +1023,8 @@ class ServeEngine:
         # engine, not the traffic the reset is drawing a baseline for
         # (the warmup path restores ptpu_engine_compiles the same way)
         self._m_tp_size.set(float(self.tp_size))
+        if self.host_tier is not None:
+            self.host_tier.republish_boot_state()
         if self._serve_tp is not None:
             self._m_allreduce.labels(mode=self._serve_tp.mode).observe(
                 self._allreduce_probe_ms)

@@ -36,6 +36,8 @@ longest warm prefix at the hottest tier.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import zlib
 from collections import OrderedDict
@@ -93,6 +95,7 @@ class HostKVTier:
         self._entries: "OrderedDict[tuple, _Entry]" = \
             OrderedDict()                    # guarded-by: self._lock
         self._bytes = 0                      # guarded-by: self._lock
+        self._warm_start_blocks = 0          # guarded-by: self._lock
         reg = registry if registry is not None else default_registry()
         self._c_demoted = reg.counter(
             "ptpu_kv_tier_demoted_blocks_total",
@@ -112,6 +115,15 @@ class HostKVTier:
             "ptpu_kv_tier_bytes", "Host-tier resident bytes")
         self._g_entries = reg.gauge(
             "ptpu_kv_tier_entries", "Host-tier resident block entries")
+        self._c_spill_saved = reg.counter(
+            "ptpu_kv_tier_spill_saved_blocks_total",
+            "Host-tier blocks spilled to disk at drain/interval")
+        self._c_spill_loaded = reg.counter(
+            "ptpu_kv_tier_spill_loaded_blocks_total",
+            "Host-tier blocks warm-started from a disk spill at boot")
+        self._g_spill_bytes = reg.gauge(
+            "ptpu_kv_tier_spill_bytes",
+            "On-disk size of the latest spill")
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -203,6 +215,147 @@ class HostKVTier:
         if limit and len(keys) > limit:
             keys = keys[-limit:]
         return [(len(k), prefix_digest(k)) for k in keys]
+
+    # -- warm restarts: disk spill ----------------------------------------
+    # Layout inside the spill dir (tier-spill.json commits LAST, so a
+    # manifest that exists implies a complete npz — the same
+    # write-tmp-then-rename commit protocol as io/checkpoint.py):
+    #   tier-spill.npz    every blob array, named e{entry}_{slot}
+    #   tier-spill.json   {"version", "int8", "crc32", "entries": [...]}
+
+    _SPILL_NPZ = "tier-spill.npz"
+    _SPILL_JSON = "tier-spill.json"
+
+    def spill(self, dirpath: str) -> int:
+        """Write every resident entry (LRU order preserved) to
+        `dirpath`, atomically replacing any previous spill. Returns the
+        number of blocks written. Payloads are immutable, so only the
+        snapshot of the entry map needs the lock — serialization runs
+        outside it."""
+        with self._lock:
+            snapshot = list(self._entries.items())
+        os.makedirs(dirpath, exist_ok=True)
+        arrays: dict = {}
+        manifest_entries = []
+        for i, (key, entry) in enumerate(snapshot):
+            slots = []
+            dtypes = []
+            for j, blob in enumerate(entry.blobs):
+                if self.int8:
+                    kq, ks, vq, vs, dtype = blob
+                    parts = (kq, ks, vq, vs)
+                    dtypes.append(np.dtype(dtype).name)
+                else:
+                    parts = blob
+                for p, arr in enumerate(parts):
+                    slot = f"e{i}_l{j}_p{p}"
+                    arrays[slot] = np.asarray(arr)
+                    slots.append(slot)
+            manifest_entries.append(
+                {"key": [int(t) for t in key], "layers": len(entry.blobs),
+                 "nbytes": entry.nbytes, "slots": slots, "dtypes": dtypes})
+        # tmp name must keep the .npz suffix (np.savez appends it)
+        npz_tmp = os.path.join(dirpath, "tier-spill.tmp.npz")
+        np.savez(npz_tmp, **arrays)
+        with open(npz_tmp, "rb") as f:
+            crc = zlib.crc32(f.read())
+        os.replace(npz_tmp, os.path.join(dirpath, self._SPILL_NPZ))
+        manifest = {"version": 1, "int8": self.int8, "crc32": crc,
+                    "entries": manifest_entries}
+        json_tmp = os.path.join(dirpath, self._SPILL_JSON + ".tmp")
+        with open(json_tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(json_tmp, os.path.join(dirpath, self._SPILL_JSON))
+        self._c_spill_saved.inc(len(snapshot))
+        self._g_spill_bytes.set(float(
+            os.path.getsize(os.path.join(dirpath, self._SPILL_NPZ))))
+        return len(snapshot)
+
+    def load_spill(self, dirpath: str) -> int:
+        """Warm-start from a spill written by `spill()`: re-inserts
+        every entry (oldest first, so relative LRU order survives the
+        restart) under the normal byte budget. Tolerant by design — a
+        missing, torn, or mode-mismatched spill warm-starts NOTHING and
+        returns 0; a cold boot is always safe. Returns blocks loaded."""
+        manifest_path = os.path.join(dirpath, self._SPILL_JSON)
+        npz_path = os.path.join(dirpath, self._SPILL_NPZ)
+        if not (os.path.exists(manifest_path) and os.path.exists(npz_path)):
+            return 0
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            if manifest.get("version") != 1 \
+                    or bool(manifest.get("int8")) != self.int8:
+                return 0
+            with open(npz_path, "rb") as f:
+                if zlib.crc32(f.read()) != manifest.get("crc32"):
+                    return 0
+            arrays = np.load(npz_path)
+            loaded = 0
+            for ent in manifest["entries"]:
+                key = tuple(int(t) for t in ent["key"])
+                blobs = []
+                slots = iter(ent["slots"])
+                for j in range(ent["layers"]):
+                    if self.int8:
+                        kq, ks, vq, vs = (arrays[next(slots)]
+                                          for _ in range(4))
+                        # scales round-trip as 0-d float64 arrays;
+                        # restore the python-float type put() stored so
+                        # dequantize promotes identically (bit-exact
+                        # revival vs the pre-restart tier)
+                        blobs.append((kq, float(ks), vq, float(vs),
+                                      np.dtype(ent["dtypes"][j])))
+                    else:
+                        blobs.append((arrays[next(slots)],
+                                      arrays[next(slots)]))
+                if self._insert_raw(key, blobs, int(ent["nbytes"])):
+                    loaded += 1
+        except (OSError, KeyError, ValueError, json.JSONDecodeError,
+                zlib.error, StopIteration):
+            return 0
+        if loaded:
+            with self._lock:
+                self._warm_start_blocks += loaded
+            self._c_spill_loaded.inc(loaded)
+        return loaded
+
+    def republish_boot_state(self) -> None:
+        """Re-publish the series that describe this tier's BOOT, not
+        its traffic: a post-warmup registry reset (engine.reset_stats)
+        zeroes every family in place, but the warm-start really did
+        happen — restore the loaded counter and occupancy gauges the
+        same way the engine restores ptpu_engine_compiles."""
+        with self._lock:
+            bytes_now, count = self._bytes, len(self._entries)
+            warm = self._warm_start_blocks
+        if warm:
+            self._c_spill_loaded.inc(warm)
+        self._g_bytes.set(float(bytes_now))
+        self._g_entries.set(float(count))
+
+    def _insert_raw(self, key: tuple, blobs: list, nbytes: int) -> bool:
+        """Insert an already-encoded entry (spill revival path): same
+        budget/LRU accounting as put(), no re-quantization."""
+        if nbytes > self.byte_budget:
+            return False
+        lru_evicted = 0
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            self._entries[key] = _Entry(blobs, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.byte_budget:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old.nbytes
+                lru_evicted += 1
+            bytes_now, count = self._bytes, len(self._entries)
+        if lru_evicted:
+            self._c_lru.inc(lru_evicted)
+        self._g_bytes.set(float(bytes_now))
+        self._g_entries.set(float(count))
+        return True
 
     # -- observability ----------------------------------------------------
     def stats(self) -> dict:

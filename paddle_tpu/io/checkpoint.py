@@ -50,7 +50,8 @@ import jax
 import numpy as np
 
 from paddle_tpu.resilience import chaos as _chaos
-from paddle_tpu.resilience.retry import RetryPolicy, retry_call
+from paddle_tpu.resilience.retry import (
+    RetryPolicy, retry_call, shared_budget)
 from paddle_tpu.utils.log import resilience_event
 
 Pytree = Any
@@ -141,7 +142,8 @@ def _barrier(name: str) -> None:
             client.wait_at_barrier(key, 600_000)
         # transient RPC failure before joining: peers are still blocked
         # on us, so a re-wait on the same key completes the rendezvous
-        retry_call(wait, policy=_BARRIER_RETRY, name="barrier")
+        retry_call(wait, policy=_BARRIER_RETRY, name="barrier",
+                   budget=shared_budget())
         return
     # No coordination client (private jax API moved?): the device-
     # collective fallback is only safe on the main thread — from a
@@ -313,7 +315,8 @@ def _write_snapshot(path: str, snap, step: Optional[int],
                 with open(os.path.join(tmp, f"shard_index-p{proc}.json"),
                           "w") as f:
                     json.dump(my_index, f)
-            retry_call(write_shards, policy=_IO_RETRY, name="ckpt_write")
+            retry_call(write_shards, policy=_IO_RETRY, name="ckpt_write",
+                       budget=shared_budget())
         except BaseException as e:
             if multi:
                 _mark_failure(path, proc, e)
@@ -426,7 +429,8 @@ class _ShardSource:
                 _chaos.maybe_fail("ckpt_read")
                 return np.load(os.path.join(self.path, fname))
             self._files[fname] = retry_call(load, policy=_IO_RETRY,
-                                            name="ckpt_read")
+                                            name="ckpt_read",
+                                            budget=shared_budget())
         return self._files[fname][slot]
 
     def read_region(self, leaf: int, region: Tuple[slice, ...],

@@ -19,7 +19,8 @@ from typing import Optional
 import jax
 
 from paddle_tpu.resilience import chaos as _chaos
-from paddle_tpu.resilience.retry import RetryPolicy, retry_call
+from paddle_tpu.resilience.retry import (
+    RetryPolicy, retry_call, shared_budget)
 
 _initialized = False
 
@@ -71,7 +72,7 @@ def init_distributed(coordinator: Optional[str] = None,
             local_device_ids=local_device_ids)
 
     retry_call(rendezvous, policy=_init_retry_policy(),
-               name="init_distributed")
+               name="init_distributed", budget=shared_budget())
     _initialized = True
 
 

@@ -16,6 +16,20 @@ clusters can arm them without code changes:
     PTPU_CHAOS_CORRUPT_STEP=S   corrupt ckpt-S right after it commits
     PTPU_CHAOS_CORRUPT_MODE=M   truncate (default) | manifest
 
+Wire-level faults ride the same contract through `NetChaosProxy` — an
+in-process TCP proxy a test or serve_bench parks in front of a
+replica so the ROUTER's failover paths (breaker, retry budget,
+hedging) are exercised against real socket behaviour, not mocks:
+
+    PTPU_CHAOS_NET_REFUSE=N     first N connects reset before any byte
+    PTPU_CHAOS_NET_5XX=N        first N requests answered 503 locally
+    PTPU_CHAOS_NET_BLACKHOLE=N  first N conns swallowed: request read,
+                                nothing ever sent back, socket held open
+    PTPU_CHAOS_NET_BLACKHOLE_AFTER=B  ...after relaying B response bytes
+                                (0 = swallow from the first byte)
+    PTPU_CHAOS_NET_SLOW=N       first N responses delayed...
+    PTPU_CHAOS_NET_SLOW_MS=M    ...by M ms before their first byte
+
 Everything is deterministic: counters are plain per-process integers,
 no RNG — the same env produces the same fault schedule every run,
 which is what lets the chaos matrix assert bit-for-bit recovery.
@@ -26,8 +40,10 @@ from __future__ import annotations
 
 import os
 import signal
+import socket
+import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from paddle_tpu.utils.log import resilience_event
 
@@ -175,3 +191,258 @@ def maybe_corrupt_checkpoint(path: str, step: Optional[int]) -> None:
               else corrupt_truncate_shard(path))
     resilience_event("chaos_inject", site="corrupt", step=step,
                      mode=mode, file=os.path.basename(target))
+
+
+# -- wire-level chaos: in-process TCP fault proxy ---------------------------
+
+class NetChaosProxy:
+    """TCP proxy with a deterministic per-connection fault schedule.
+
+    `NetChaosProxy(upstream_port).start()` listens on an ephemeral
+    port; point the router at `http://127.0.0.1:{proxy.port}` and every
+    connection is classified ONCE, under the lock, against the
+    remaining fault budgets — counters, no RNG, same schedule every
+    run — then handled entirely outside it:
+
+      refuse     accept + immediate RST (SO_LINGER 0): the connect-
+                 refused path — the router's breaker must count it
+      http_503   a canned local `503 chaos` without touching upstream:
+                 the retryable-status path
+      blackhole  request bytes swallowed, nothing ever written back,
+                 socket HELD OPEN — the accept-queue / mid-stream
+                 black-hole: only a timeout or a hedge saves the client.
+                 `blackhole_after > 0` relays that many response bytes
+                 first, turning it into a mid-stream stall
+      slow       first response byte delayed `slow_ms` — the straggler
+                 a hedged request should beat
+      relay      no fault: transparent byte pump both ways
+
+    Budgets load from `PTPU_CHAOS_NET_*` at construction; tests and
+    serve_bench can also drive them programmatically via `arm()` /
+    `heal()` mid-run (e.g. black-hole one replica while traffic is
+    live). `stats()` reports faults actually delivered."""
+
+    _MODES = ("refuse", "http_503", "blackhole", "slow")
+    _ENV = {"refuse": "PTPU_CHAOS_NET_REFUSE",
+            "http_503": "PTPU_CHAOS_NET_5XX",
+            "blackhole": "PTPU_CHAOS_NET_BLACKHOLE",
+            "slow": "PTPU_CHAOS_NET_SLOW"}
+
+    def __init__(self, upstream_port: int, upstream_host: str = "127.0.0.1",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.upstream = (upstream_host, upstream_port)
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        # mode -> remaining injection budget     # guarded-by: self._lock
+        self._budget: Dict[str, int] = {
+            m: _int_env(self._ENV[m]) for m in self._MODES}
+        # mode -> faults delivered               # guarded-by: self._lock
+        self._delivered: Dict[str, int] = {m: 0 for m in self._MODES}
+        self.blackhole_after = _int_env("PTPU_CHAOS_NET_BLACKHOLE_AFTER")
+        self.slow_ms = _int_env("PTPU_CHAOS_NET_SLOW_MS", 200)
+        self._lsock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._conns: List[socket.socket] = []    # guarded-by: self._lock
+        self._closing = False                    # guarded-by: self._lock
+
+    # -- control ------------------------------------------------------------
+
+    def arm(self, mode: str, n: int = 1 << 30) -> None:
+        """Set `mode`'s remaining budget to n (default: effectively
+        forever, until heal())."""
+        if mode not in self._MODES:
+            raise ValueError(f"unknown net-chaos mode {mode!r}")
+        with self._lock:
+            self._budget[mode] = n
+
+    def heal(self) -> None:
+        """Clear every fault budget: the proxy becomes a pure relay."""
+        with self._lock:
+            for m in self._MODES:
+                self._budget[m] = 0
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._delivered)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "NetChaosProxy":
+        self._lsock = socket.create_server((self.host, self.port))
+        self.port = self._lsock.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="ptpu-net-chaos")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closing = True
+            conns, self._conns = self._conns, []
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "NetChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- data path ----------------------------------------------------------
+
+    def _classify(self) -> Optional[str]:
+        """Spend one fault budget for a fresh connection (priority
+        order = _MODES); None == relay cleanly."""
+        with self._lock:
+            for m in self._MODES:
+                if self._budget[m] > 0:
+                    self._budget[m] -= 1
+                    self._delivered[m] += 1
+                    remaining = self._budget[m]
+                    break
+            else:
+                return None
+        resilience_event("chaos_net", mode=m, port=self.port,
+                         remaining=remaining)
+        return m
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return                          # listener closed: stop()
+            with self._lock:
+                if self._closing:
+                    conn.close()
+                    return
+                self._conns.append(conn)
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        mode = self._classify()
+        try:
+            if mode == "refuse":
+                # linger-0 close turns FIN into RST: a true refusal
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                conn.close()
+                return
+            if mode == "http_503":
+                self._swallow_request(conn)
+                body = b"chaos: injected 503\n"
+                conn.sendall(
+                    b"HTTP/1.1 503 Service Unavailable\r\n"
+                    b"Content-Type: text/plain\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\nConnection: close\r\n\r\n" + body)
+                conn.close()
+                return
+            if mode == "blackhole" and self.blackhole_after <= 0:
+                # swallow forever: recv until the CLIENT gives up
+                while conn.recv(65536):
+                    pass
+                conn.close()
+                return
+            self._relay(conn, mode)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _swallow_request(self, conn: socket.socket) -> None:
+        """Read until the request head is plausibly complete (blank
+        line) so the client never sees a write error before our
+        response."""
+        buf = b""
+        conn.settimeout(1.0)
+        try:
+            while b"\r\n\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+        except socket.timeout:
+            return
+
+    def _relay(self, conn: socket.socket, mode: Optional[str]) -> None:
+        """Transparent pump, with the slow / mid-stream-blackhole faults
+        applied to the upstream->client direction."""
+        up = socket.create_connection(self.upstream, timeout=10)
+        with self._lock:
+            if self._closing:
+                up.close()
+                return
+            self._conns.append(up)
+        stop_fwd = threading.Event()
+
+        def pump_up() -> None:                  # client -> upstream
+            try:
+                while True:
+                    data = conn.recv(65536)
+                    if not data:
+                        break
+                    up.sendall(data)
+                try:
+                    up.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+            except OSError:
+                pass
+
+        def pump_down() -> None:                # upstream -> client
+            sent = 0
+            first = True
+            try:
+                while True:
+                    data = up.recv(65536)
+                    if not data:
+                        break
+                    if stop_fwd.is_set():
+                        continue                # black-holed mid-stream
+                    if first and mode == "slow":
+                        time.sleep(self.slow_ms / 1000.0)
+                    first = False
+                    if mode == "blackhole":
+                        room = self.blackhole_after - sent
+                        if room <= 0:
+                            stop_fwd.set()
+                            continue
+                        data = data[:room]
+                    conn.sendall(data)
+                    sent += len(data)
+                if not stop_fwd.is_set():
+                    try:
+                        conn.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+
+        t_up = threading.Thread(target=pump_up, daemon=True)
+        t_down = threading.Thread(target=pump_down, daemon=True)
+        t_up.start()
+        t_down.start()
+        t_up.join()
+        t_down.join()
+        try:
+            up.close()
+        except OSError:
+            pass

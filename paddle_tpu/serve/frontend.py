@@ -72,8 +72,10 @@ import threading
 import time
 import uuid
 from collections import deque
+from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+from urllib.parse import urlsplit
 
 from paddle_tpu.engine.engine import ServeEngine
 from paddle_tpu.engine.scheduler import Request
@@ -124,7 +126,10 @@ class ServeFrontend:
                  watchdog_s: float = 0.0,
                  flightrec_out: Optional[str] = None,
                  flightrec_capacity: int = 256,
-                 enable_chaos: bool = False):
+                 enable_chaos: bool = False,
+                 router_url: Optional[str] = None,
+                 register_interval_s: float = 2.0,
+                 tier_spill_interval_s: float = 0.0):
         self.engine = engine
         self.host = host
         self.port = port
@@ -139,6 +144,22 @@ class ServeFrontend:
         self._warmup = warmup
         self._enable_chaos = enable_chaos
         self.exit_code: Optional[int] = None
+        # dynamic membership (RESILIENCE.md §fleet): a router url turns
+        # on the registration heartbeat — POST /register {"url": ...}
+        # every register_interval_s, so the replica joins the fleet
+        # without being on the router's argv, and a RESTARTED replica
+        # (new process, same port) re-admits itself within one beat.
+        self.router_url = router_url.rstrip("/") if router_url else None
+        self.register_interval_s = register_interval_s
+        # warm restarts: > 0 spills the host KV tier to the engine's
+        # tier_spill_dir every interval ON TOP of the drain-time spill,
+        # so even a SIGKILLed replica warm-starts from a recent
+        # snapshot (the spill replaces atomically; a torn write is
+        # never visible)
+        self.tier_spill_interval_s = tier_spill_interval_s
+        self._spill_next = 0.0               # engine-loop thread only
+        self._register_thread: Optional[threading.Thread] = None
+        self._stop_register = threading.Event()
 
         self._server: Optional[ThreadingHTTPServer] = None
         self._engine_thread: Optional[threading.Thread] = None
@@ -254,7 +275,43 @@ class ServeFrontend:
         self._serve_thread.start()
         serve_event("serve_listening", host=self.host, port=self.port,
                     url=self.url)
+        if self.router_url:
+            self._register_thread = threading.Thread(
+                target=self._register_loop, daemon=True,
+                name="ptpu-serve-register")
+            self._register_thread.start()
         return self
+
+    def _register_once(self) -> bool:
+        """One POST /register heartbeat to the router; False when the
+        router is unreachable (normal during rolling restarts — the
+        next beat retries)."""
+        parts = urlsplit(self.router_url)
+        try:
+            conn = HTTPConnection(parts.hostname, parts.port or 80,
+                                  timeout=5.0)
+            try:
+                conn.request(
+                    "POST", "/register",
+                    body=json.dumps({"url": self.url}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                return resp.status == 200
+            finally:
+                conn.close()
+        except OSError:
+            return False
+
+    def _register_loop(self) -> None:
+        registered = False
+        while not self._stop_register.is_set():
+            ok = self._register_once()
+            if ok and not registered:
+                serve_event("serve_registered", router=self.router_url,
+                            url=self.url)
+            registered = ok
+            self._stop_register.wait(self.register_interval_s)
 
     @property
     def url(self) -> str:
@@ -328,6 +385,10 @@ class ServeFrontend:
         self._teardown()
 
     def _teardown(self) -> None:
+        self._stop_register.set()
+        if self._register_thread is not None:
+            self._register_thread.join(timeout=5)
+            self._register_thread = None
         self.slo.stop()
         self.flightrec.uninstall()
         if self._sup is not None:
@@ -359,6 +420,10 @@ class ServeFrontend:
                         self._directory = snapshot
                         self._debug_snapshot = debug
                     self._check_slo_burn()
+                if (self.tier_spill_interval_s > 0
+                        and now >= self._spill_next):
+                    self._spill_next = now + self.tier_spill_interval_s
+                    self._spill_tier("interval")
                 if self._draining:
                     if self._drain_finished():
                         break
@@ -376,6 +441,10 @@ class ServeFrontend:
             serve_event("serve_engine_crash", error=repr(e))
             raise
         finally:
+            # spill the host tier LAST, with no traffic left to mutate
+            # it: the successor process warm-starts from exactly the
+            # state the drain left behind
+            self._spill_tier("drain")
             if self._draining:
                 self.exit_code = PREEMPT_EXIT_CODE
                 serve_event("serve_drained",
@@ -383,6 +452,23 @@ class ServeFrontend:
                                           - self._drain_started, 3),
                             exit_code=self.exit_code)
             self._stopped.set()
+
+    def _spill_tier(self, cause: str) -> None:
+        """Spill the host KV tier to the engine's tier_spill_dir
+        (engine-loop thread only — the tier's lock makes the read
+        consistent, the rename makes the write atomic). No-op without
+        a tier or a dir; a failed spill is an event, never a crash."""
+        eng = self.engine
+        if eng.host_tier is None or not eng.tier_spill_dir:
+            return
+        try:
+            blocks = eng.host_tier.spill(eng.tier_spill_dir)
+        except OSError as e:
+            serve_event("tier_spill_failed", cause=cause, error=repr(e))
+            return
+        if blocks or cause == "drain":
+            serve_event("tier_spill", cause=cause, blocks=blocks,
+                        dir=eng.tier_spill_dir)
 
     def _step_once(self) -> bool:
         """One engine step, under the hung-step watchdog when armed.

@@ -57,6 +57,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-tier-int8", action="store_true",
                    help="store host-tier blocks int8-quantized "
                         "(roughly doubles the tier's effective budget)")
+    p.add_argument("--tier-spill-dir", default=None,
+                   help="warm-restart directory for the host KV tier: "
+                        "the tier spills here when a drain completes "
+                        "(and every --tier-spill-interval-s when > 0), "
+                        "and a fresh boot warm-starts from the spill — "
+                        "restart with the SAME dir to revive warm KV")
+    p.add_argument("--tier-spill-interval-s", type=float, default=0.0,
+                   help="also spill the host tier periodically (0 = "
+                        "drain-time only); lets a SIGKILLed replica "
+                        "warm-start from a recent snapshot")
     p.add_argument("--tp-size", type=int, default=1,
                    help="tensor-parallel degree: shard the one compiled "
                         "step over the first N devices (weights + KV "
@@ -64,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "forces N virtual devices before jax initializes; "
                         "PTPU_SERVE_ALLREDUCE=fp|int8 picks the decode "
                         "collective wire format")
+    # fleet membership (serve/router.py POST /register)
+    p.add_argument("--router-url", default=None,
+                   help="router base url: heartbeat POST /register so "
+                        "this replica joins (and re-joins after a "
+                        "restart) without being on the router's argv")
+    p.add_argument("--register-interval-s", type=float, default=2.0,
+                   help="registration heartbeat cadence")
     # front-end / admission / drain
     p.add_argument("--max-queue-depth", type=int, default=64)
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
@@ -135,7 +152,8 @@ def build_frontend(a: argparse.Namespace):
             enable_prefix_cache=not a.no_prefix_cache,
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
-            kv_tier_int8=a.kv_tier_int8, tp_size=a.tp_size)
+            kv_tier_int8=a.kv_tier_int8,
+            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size)
     else:
         import jax
         import jax.numpy as jnp
@@ -154,7 +172,8 @@ def build_frontend(a: argparse.Namespace):
             enable_prefix_cache=not a.no_prefix_cache,
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
-            kv_tier_int8=a.kv_tier_int8, tp_size=a.tp_size)
+            kv_tier_int8=a.kv_tier_int8,
+            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size)
     slo = SLOMonitor(
         registry,
         objectives=default_objectives(
@@ -175,7 +194,10 @@ def build_frontend(a: argparse.Namespace):
         watchdog_s=a.watchdog_s,
         flightrec_out=a.flightrec_out,
         flightrec_capacity=a.flightrec_capacity,
-        enable_chaos=a.enable_chaos)
+        enable_chaos=a.enable_chaos,
+        router_url=a.router_url,
+        register_interval_s=a.register_interval_s,
+        tier_spill_interval_s=a.tier_spill_interval_s)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -10,10 +10,12 @@ replicas' own scraped telemetry — the router holds no model state:
   share a prompt prefix land on the SAME replica (each engine's block
   pool is private). The primary replica is a stable hash — crc32, not
   Python's per-process-salted `hash()` — of the first `prefix_len`
-  prompt tokens, modulo N: every request with the same system prompt
-  hashes to the same replica, so the fleet-wide hit rate tracks the
-  single-replica hit rate instead of decaying ~1/N (serve_bench's
-  router scenario measures exactly this).
+  prompt tokens, modulo the READY set: every request with the same
+  system prompt hashes to the same replica while the fleet is stable,
+  so the fleet-wide hit rate tracks the single-replica hit rate
+  instead of decaying ~1/N (serve_bench's router scenario measures
+  exactly this). When a replica dies, the hash re-maps over the
+  survivors only — no request is sticky to a corpse.
 - FLEET PREFIX DIRECTORY. The hash is a degenerate directory (it
   predicts where a prefix SHOULD be warm); the real one is scraped:
   each replica advertises its warm prefixes on /kvprefixes as
@@ -40,11 +42,39 @@ replicas' own scraped telemetry — the router holds no model state:
   bounded deadline, and exits PREEMPT_EXIT_CODE (75) — a router is as
   preemptible as the replicas behind it.
 
-The proxy relays the replica's SSE byte stream unbuffered, so the
-`[DONE]` untruncated-stream invariant survives the extra hop, and a
-client disconnect propagates: the router's write fails, it drops the
-replica connection, the replica's write fails, the engine cancels and
-frees KV blocks.
+FLEET FAULT TOLERANCE (RESILIENCE.md §fleet). The router is where a
+replica failure is turned back into a successful client request:
+
+- DYNAMIC MEMBERSHIP. The argv replica list is only the bootstrap
+  seed: replicas heartbeat `POST /register {"url": ...}` and are
+  admitted once a health probe passes. Every replica carries a
+  circuit breaker (closed -> open -> half-open): `breaker_fails`
+  consecutive scrape/connect failures open it — the replica is
+  evicted from routing — and after `breaker_open_s` ONE half-open
+  probe per scrape tick decides rejoin vs re-open. A re-register from
+  an evicted replica forces the probe immediately, so a warm restart
+  is routable within one scrape interval.
+- RETRY BUDGET. Failover re-attempts draw from a RetryBudget token
+  bucket (resilience/retry.py) deposited by successful traffic: when
+  the whole fleet degrades, the bucket drains and requests shed 503
+  reason="retry_budget" instead of amplifying the overload into a
+  retry storm.
+- HEDGED REQUESTS. A request whose first response byte hasn't arrived
+  after ~`hedge_ttft_mult` x the scraped TTFT p95 fires ONE hedge to
+  the next-ranked replica; first response wins, the loser's connection
+  is closed so its engine cancels and its KV blocks free. Hedges spend
+  the same retry budget (no hedge storms either).
+- FAILOVER WITH STREAM RESUME. The relay is frame-level (SSE), not
+  byte-level: when a replica dies mid-stream the router re-sends the
+  request to the next candidate and SKIPS the frames the client
+  already has — decode is greedy and every replica holds identical
+  weights, so the replayed frames are identical and the client sees
+  one untruncated stream ending in `[DONE]`.
+
+The relay is unbuffered per frame, so the `[DONE]` untruncated-stream
+invariant survives the extra hop, and a client disconnect propagates:
+the router's write fails, it drops the replica connection, the
+replica's write fails, the engine cancels and frees KV blocks.
 
 FLEET OBSERVABILITY (OBSERVABILITY.md §fleet). The router is also the
 fleet's one observability front door:
@@ -60,15 +90,21 @@ fleet's one observability front door:
   log-bucketed histograms merge bucket-by-bucket (identical layout by
   construction), gauges re-label per replica;
 - `GET /debug` is the replica table as the router sees it — ready
-  state, scraped gauges, prefix-directory size, and scrape staleness
-  (also exported as `ptpu_router_scrape_age_seconds{replica}`, so
-  routing-on-stale-data is visible on the scrape plane too).
+  state, breaker state, scraped gauges, prefix-directory size, and
+  scrape staleness (also exported as
+  `ptpu_router_scrape_age_seconds{replica}`, so routing-on-stale-data
+  is visible on the scrape plane too). Scrapes run on per-replica
+  threads with their own `scrape_timeout_s`, so one wedged replica
+  cannot stall the loop past its interval — its staleness gauge just
+  keeps growing while the rest of the fleet stays fresh.
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import queue
+import re
 import signal
 import threading
 import time
@@ -84,7 +120,9 @@ from paddle_tpu.obs.http import CONTENT_TYPE, json_route, obs_response
 from paddle_tpu.obs.metrics import MetricsRegistry
 from paddle_tpu.obs.tracing import RequestTracer, stitch_fragments
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
-from paddle_tpu.serve.sse import parse_prometheus_values
+from paddle_tpu.resilience.retry import RetryBudget
+from paddle_tpu.serve.sse import (DONE_SENTINEL, iter_sse,
+                                  parse_prometheus_values, sse_event)
 from paddle_tpu.utils.log import serve_event
 
 
@@ -112,12 +150,46 @@ def prefix_digest(tokens: Sequence[int]) -> str:
 # copies, a host-tier one needs a DMA revival, anything else re-prefills
 _TIER_RANK = {"device": 1, "host": 0}
 
+# breaker state as a gauge level (ptpu_router_breaker_state)
+_BREAKER_LEVEL = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def _bucket_quantile(vals: dict, family: str, q: float) -> float:
+    """histogram_quantile over a flat scrape dict (same walk as
+    serve_bench's verdicts): smallest bucket bound covering the q-rank,
+    NaN when the family has no samples."""
+    per_le: Dict[float, float] = {}
+    prefix = family + "_bucket{"
+    for key, v in vals.items():
+        if not key.startswith(prefix):
+            continue
+        m = _LE_RE.search(key)
+        if not m:
+            continue
+        le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+        per_le[le] = per_le.get(le, 0.0) + v
+    if not per_le:
+        return float("nan")
+    bounds = sorted(per_le)
+    total = per_le[bounds[-1]]
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    for le in bounds:
+        if per_le[le] >= rank:
+            return le
+    return float("inf")
+
 
 class ReplicaState:
     """What the scrape loop knows about one replica right now."""
 
     __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
-                 "queue_depth", "last_scrape", "prefixes")
+                 "queue_depth", "last_scrape", "prefixes", "fails",
+                 "breaker", "open_until", "ttft_p95_ms", "registered",
+                 "scraping")
 
     def __init__(self, url: str):
         parts = urlsplit(url)
@@ -131,12 +203,35 @@ class ReplicaState:
         self.last_scrape = 0.0
         # fleet prefix directory rows: {(len, digest): tier}
         self.prefixes: Dict[Tuple[int, str], str] = {}
+        # circuit breaker: consecutive scrape/connect failures ->
+        # closed -> open (evicted) -> half_open (one probe) -> closed
+        self.fails = 0
+        self.breaker = "closed"
+        self.open_until = 0.0
+        self.ttft_p95_ms = 0.0
+        self.registered = False     # joined via POST /register
+        self.scraping = False       # a scrape thread is on it right now
+
+
+class _RelayState:
+    """Per-request relay progress shared across failover attempts:
+    whether the client already has status+headers, and how many data
+    frames it has received (replayed frames up to `sent` are skipped
+    on a resumed stream)."""
+
+    __slots__ = ("started", "sent")
+
+    def __init__(self):
+        self.started = False
+        self.sent = 0
 
 
 class Router:
     """`Router(["http://h:p1", "http://h:p2"]).start()` binds `.port`
     and proxies `/v1/completions`; `/metrics`, `/healthz`, `/readyz`
-    describe the router itself (ready iff >=1 replica is ready)."""
+    describe the router itself (ready iff >=1 replica is ready). The
+    url list is the bootstrap seed — replicas may also join live via
+    `POST /register`."""
 
     def __init__(self, replica_urls: Sequence[str],
                  host: str = "127.0.0.1", port: int = 0,
@@ -144,9 +239,16 @@ class Router:
                  scrape_interval_s: float = 0.5,
                  drain_deadline_s: float = 30.0,
                  connect_timeout_s: float = 10.0,
-                 enable_directory: bool = True):
-        if not replica_urls:
-            raise ValueError("router needs at least one replica url")
+                 enable_directory: bool = True,
+                 scrape_timeout_s: float = 2.0,
+                 breaker_fails: int = 3,
+                 breaker_open_s: float = 2.0,
+                 retry_budget_ratio: float = 0.2,
+                 retry_budget_burst: float = 16.0,
+                 enable_hedge: bool = True,
+                 hedge_ttft_mult: float = 3.0,
+                 hedge_min_s: float = 0.05,
+                 hedge_max_s: float = 2.0):
         self.replicas = [ReplicaState(u) for u in replica_urls]
         self.host = host
         self.port = port
@@ -156,9 +258,19 @@ class Router:
         self.scrape_interval_s = scrape_interval_s
         self.drain_deadline_s = drain_deadline_s
         self.connect_timeout_s = connect_timeout_s
+        self.scrape_timeout_s = scrape_timeout_s
+        self.breaker_fails = max(1, int(breaker_fails))
+        self.breaker_open_s = breaker_open_s
+        self.enable_hedge = enable_hedge
+        self.hedge_ttft_mult = hedge_ttft_mult
+        self.hedge_min_s = hedge_min_s
+        self.hedge_max_s = hedge_max_s
         self.exit_code: Optional[int] = None
 
         self.obs = MetricsRegistry()    # the router's OWN process story
+        self.retry_budget = RetryBudget(ratio=retry_budget_ratio,
+                                        burst=retry_budget_burst,
+                                        registry=self.obs)
         self._m_routed = self.obs.counter(
             "ptpu_router_requests_total",
             "Requests proxied, by replica and route kind",
@@ -166,7 +278,7 @@ class Router:
         self._m_sheds = self.obs.counter(
             "ptpu_router_sheds_total",
             "Requests the router itself bounced (503)",
-            labelnames=("reason",))     # reason=draining|no_replica
+            labelnames=("reason",))  # reason=draining|no_replica|retry_budget
         self._m_replica_ready = self.obs.gauge(
             "ptpu_router_replica_ready", "1 when the replica passes /readyz",
             labelnames=("replica",))
@@ -194,6 +306,26 @@ class Router:
             "Seconds since the replica's gauges were last scraped "
             "successfully (-1 = never); routing decisions are only as "
             "fresh as this", labelnames=("replica",))
+        self._m_retries = self.obs.counter(
+            "ptpu_router_retries_total",
+            "Failover re-attempts, by what failed on the previous try",
+            labelnames=("kind",))       # kind=connect|shed|stream
+        self._m_hedges = self.obs.counter(
+            "ptpu_router_hedges_total",
+            "Hedged requests fired against a slow first replica",
+            labelnames=("outcome",))    # outcome=won|lost|denied
+        self._m_breaker = self.obs.gauge(
+            "ptpu_router_breaker_state",
+            "Replica circuit breaker: 0 closed, 1 half-open, 2 open "
+            "(evicted from routing)", labelnames=("replica",))
+        self._m_membership = self.obs.counter(
+            "ptpu_router_membership_events_total",
+            "Dynamic-membership transitions",
+            labelnames=("event",))      # event=register|evict|rejoin
+        self._m_replica_ttft = self.obs.gauge(
+            "ptpu_router_replica_ttft_p95_ms",
+            "Replica's scraped TTFT p95 (bucket upper bound) — the "
+            "base of the hedge delay", labelnames=("replica",))
 
         # router-side spans under the fleet trace id: one synthetic
         # request id per proxied POST, stitched with the replica's
@@ -207,11 +339,99 @@ class Router:
         self._stop_scrape = threading.Event()
         # One lock covers the router's mutable shared state: the in-flight
         # count AND every ReplicaState field the scrape loop and handler
-        # threads both touch. Network I/O never happens under it.
+        # threads both touch (including membership appends). Network I/O
+        # never happens under it.
         self._lock = threading.Lock()
         self._inflight = 0          # guarded-by: self._lock
         self._draining = False      # guarded-by: self._lock
         self._drained = threading.Event()
+
+    # -- membership / circuit breaker -------------------------------------
+    def _note_failure(self, r: ReplicaState, reason: str) -> None:
+        """One scrape/connect/stream failure on `r`: demote from
+        routing and advance the breaker — `breaker_fails` consecutive
+        failures open it (eviction), a failed half-open probe re-opens
+        it."""
+        evicted = False
+        with self._lock:
+            r.ready = False
+            r.reason = reason
+            r.fails += 1
+            if r.breaker == "closed" and r.fails >= self.breaker_fails:
+                r.breaker = "open"
+                r.open_until = time.monotonic() + self.breaker_open_s
+                evicted = True
+            elif r.breaker == "half_open":
+                r.breaker = "open"
+                r.open_until = time.monotonic() + self.breaker_open_s
+            state, fails = r.breaker, r.fails
+        self._m_replica_ready.labels(replica=r.url).set(0.0)
+        self._m_breaker.labels(replica=r.url).set(_BREAKER_LEVEL[state])
+        if evicted:
+            self._m_membership.labels(event="evict").inc()
+            serve_event("router_evict", replica=r.url, fails=fails,
+                        reason=reason)
+
+    def register_replica(self, url: str) -> ReplicaState:
+        """Admit (or re-admit) a replica by base url: the programmatic
+        half of POST /register. New url -> appended to the table and
+        probed; evicted url -> breaker forced half-open and probed NOW,
+        so a restarted replica is routable without waiting out
+        `breaker_open_s`."""
+        url = url.rstrip("/")
+        with self._lock:
+            r = next((x for x in self.replicas if x.url == url), None)
+            is_new = r is None
+            if is_new:
+                r = ReplicaState(url)
+                r.registered = True
+                self.replicas.append(r)
+            elif r.breaker == "open":
+                r.breaker = "half_open"
+                r.open_until = 0.0
+            ready = r.ready
+        if is_new:
+            self._m_membership.labels(event="register").inc()
+            serve_event("router_register", replica=url,
+                        replicas=len(self.replicas))
+        if not ready:
+            # probe on the caller's thread (never under the lock): a
+            # passing probe flips it ready/rejoined immediately
+            self._scrape_once(r)
+        return r
+
+    def _handle_register(self, h: BaseHTTPRequestHandler) -> None:
+        try:
+            length = int(h.headers.get("Content-Length", "0"))
+            body = json.loads(h.rfile.read(length) or b"{}")
+            url = str(body.get("url") or "")
+        except (ValueError, json.JSONDecodeError):
+            url = ""
+        if not url.startswith("http"):
+            payload = json.dumps({"ok": False,
+                                  "error": "body must be {'url': "
+                                           "'http://host:port'}"})
+            self._send_json(h, 400, payload)
+            return
+        r = self.register_replica(url)
+        with self._lock:
+            known = len(self.replicas)
+            ready = r.ready
+        self._send_json(h, 200, json.dumps(
+            {"ok": True, "ready": ready, "replicas": known}))
+
+    @staticmethod
+    def _send_json(h: BaseHTTPRequestHandler, status: int,
+                   payload: str) -> None:
+        body = payload.encode() + b"\n"
+        try:
+            h.send_response(status)
+            h.send_header("Content-Type", "application/json")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
 
     # -- scrape loop ------------------------------------------------------
     def _scrape_once(self, r: ReplicaState) -> None:
@@ -224,7 +444,7 @@ class Router:
         prefixes: Dict[Tuple[int, str], str] = {}
         try:
             conn = HTTPConnection(r.host, r.port,
-                                  timeout=self.connect_timeout_s)
+                                  timeout=self.scrape_timeout_s)
             try:
                 conn.request("GET", "/readyz")
                 resp = conn.getresponse()
@@ -252,36 +472,99 @@ class Router:
                 conn.close()
             vals = parse_prometheus_values(text)
         except OSError as e:
-            ready = False
-            reason = f"scrape failed: {e}"
+            self._note_failure(r, f"scrape failed: {e}")
+            with self._lock:
+                last_scrape = r.last_scrape
+            age = (time.monotonic() - last_scrape) if last_scrape else -1.0
+            self._m_scrape_age.labels(replica=r.url).set(age)
+            return
+        ttft = _bucket_quantile(vals, "ptpu_serve_ttft_ms", 0.95)
         with self._lock:
+            rejoined = r.breaker != "closed"
+            r.breaker = "closed"
+            r.fails = 0
+            r.open_until = 0.0
             r.ready = ready
             r.reason = reason
             r.prefixes = prefixes
             if vals:
                 r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
                 r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
+                if ttft == ttft and ttft != float("inf"):   # not NaN/Inf
+                    r.ttft_p95_ms = ttft
                 r.last_scrape = time.monotonic()
             hit_rate, queue_depth = r.hit_rate, r.queue_depth
-            last_scrape = r.last_scrape
+            last_scrape, ttft_pub = r.last_scrape, r.ttft_p95_ms
+        if rejoined:
+            self._m_membership.labels(event="rejoin").inc()
+            serve_event("router_rejoin", replica=r.url, ready=ready)
         self._m_replica_ready.labels(replica=r.url).set(1.0 if ready else 0.0)
+        self._m_breaker.labels(replica=r.url).set(0.0)
         self._m_replica_hit.labels(replica=r.url).set(hit_rate)
         self._m_replica_depth.labels(replica=r.url).set(queue_depth)
         self._m_replica_prefixes.labels(replica=r.url).set(
             float(len(prefixes)))
+        self._m_replica_ttft.labels(replica=r.url).set(ttft_pub)
         # staleness: keeps GROWING while scrapes fail, so alerting can
         # tell "replica down" from "replica briefly slow"
         age = (time.monotonic() - last_scrape) if last_scrape else -1.0
         self._m_scrape_age.labels(replica=r.url).set(age)
 
-    def scrape_now(self) -> None:
-        """One synchronous pass over every replica (startup, tests)."""
-        for r in self.replicas:
+    def _scrape_guard(self, r: ReplicaState) -> None:
+        try:
             self._scrape_once(r)
+        finally:
+            with self._lock:
+                r.scraping = False
+
+    def scrape_now(self, wait_s: Optional[float] = None) -> None:
+        """One pass over every replica, each on its own thread with its
+        own `scrape_timeout_s` — a wedged /metrics handler delays ONLY
+        its replica (whose in-flight flag also stops pileup across
+        ticks); the rest of the fleet stays fresh. Joins up to `wait_s`
+        (default: one scrape timeout + slack) so startup and tests see
+        a synchronous pass."""
+        with self._lock:
+            reps = list(self.replicas)
+        now = time.monotonic()
+        threads: List[threading.Thread] = []
+        for r in reps:
+            with self._lock:
+                if r.scraping:          # previous scrape still stuck on it
+                    skip, half_open = True, False
+                elif r.breaker == "open" and now < r.open_until:
+                    skip, half_open = True, False   # evicted: wait out open_s
+                else:
+                    skip = False
+                    half_open = r.breaker == "open"
+                    if half_open:
+                        r.breaker = "half_open"     # one probe
+                    r.scraping = True
+                last_scrape = r.last_scrape
+            if skip:
+                age = (now - last_scrape) if last_scrape else -1.0
+                self._m_scrape_age.labels(replica=r.url).set(age)
+                continue
+            if half_open:
+                self._m_breaker.labels(replica=r.url).set(
+                    _BREAKER_LEVEL["half_open"])
+            t = threading.Thread(target=self._scrape_guard, args=(r,),
+                                 daemon=True, name="ptpu-router-scrape-one")
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + (
+            wait_s if wait_s is not None else self.scrape_timeout_s + 0.5)
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
 
     def _scrape_loop(self) -> None:
+        # wait_s=0: the periodic tick never waits on the scrape
+        # threads, so the cadence stays `scrape_interval_s` even while
+        # one replica's scrape is timing out — a black-holed member
+        # must not slow down how fast a DEAD member is detected. The
+        # per-replica `scraping` flag stops pileup on the slow one.
         while not self._stop_scrape.wait(self.scrape_interval_s):
-            self.scrape_now()
+            self.scrape_now(wait_s=0.0)
 
     # -- routing policy ---------------------------------------------------
     def _directory_best(self, prompt: Sequence[int],
@@ -293,8 +576,7 @@ class Router:
         best: Optional[ReplicaState] = None
         best_score = (-1, -1)
         memo: Dict[int, str] = {}
-        for r in self.replicas:
-            ready, _, _, prefixes = snapshot[r]
+        for r, (ready, _, _, prefixes, _) in snapshot.items():
             if not ready:
                 continue
             for (ln, dg), tier in prefixes.items():
@@ -308,34 +590,52 @@ class Router:
         return best
 
     def _plan(self, prompt: Sequence[int]
-              ) -> Tuple[List[ReplicaState], Optional[ReplicaState]]:
-        """(candidates in try-order, directory pick or None). Base
-        order: the sticky prefix-hash primary first (even when it looks
-        not-ready the scrape may be stale — a 503 there falls through),
-        then every OTHER ready replica ranked best-fallback-first:
-        highest scraped hit rate, then shortest queue. When the fleet
-        prefix directory knows a ready replica holding a warm prefix of
-        this prompt, that replica is promoted to the front — warm KV
-        beats where the hash says the prefix should live."""
-        primary = self.replicas[prefix_shard(prompt, len(self.replicas),
-                                             self.prefix_len)]
+              ) -> Tuple[List[ReplicaState], Optional[ReplicaState],
+                         Optional[ReplicaState]]:
+        """(candidates in try-order, directory pick or None, sticky).
+        The hash primary maps over the READY set (in table order), so a
+        dead replica's shard re-maps over survivors; `sticky` is the
+        hash over the FULL member table — the label reference point, so
+        stickiness verdicts don't shift when readiness flaps. Ready
+        fallbacks rank best-first (highest scraped hit rate, shortest
+        queue); routable-but-not-ready replicas trail as a last ditch
+        (the scrape may be stale); breaker-open replicas are not tried
+        at all. When the fleet prefix directory knows a ready replica
+        holding a warm prefix of this prompt, that replica is promoted
+        to the front — warm KV beats where the hash says the prefix
+        should live."""
         with self._lock:    # one consistent snapshot to rank against
             stats = {r: (r.ready, r.hit_rate, r.queue_depth,
-                         dict(r.prefixes))
+                         dict(r.prefixes), r.breaker)
                      for r in self.replicas}
+        members = list(stats.keys())
+        if not members:
+            return [], None, None
+        sticky = members[prefix_shard(prompt, len(members),
+                                      self.prefix_len)]
+        routable = [r for r in members if stats[r][4] != "open"]
+        ready = [r for r in routable if stats[r][0]]
+        if ready:
+            primary = ready[prefix_shard(prompt, len(ready),
+                                         self.prefix_len)]
+            fallbacks = sorted(
+                (r for r in ready if r is not primary),
+                key=lambda r: (-stats[r][1], stats[r][2]))
+            order = [primary] + fallbacks
+            in_order = set(map(id, order))
+            order += [r for r in routable if id(r) not in in_order]
+        else:
+            # none ready: try the routable set anyway (scrapes may be
+            # stale) — but NEVER a breaker-open replica; a fully open
+            # fleet sheds until a half-open probe rejoins someone
+            order = routable
         dir_pick = (self._directory_best(prompt, stats)
                     if self.enable_directory else None)
-        fallbacks = sorted(
-            (r for r in self.replicas if r is not primary and stats[r][0]),
-            key=lambda r: (-stats[r][1], stats[r][2]))
-        if stats[primary][0]:
-            order = [primary] + fallbacks
-        else:
-            order = fallbacks + [primary]   # last-ditch: maybe stale scrape
         if dir_pick is not None and dir_pick is not order[0]:
-            order.remove(dir_pick)
+            if dir_pick in order:
+                order.remove(dir_pick)
             order.insert(0, dir_pick)
-        return order, dir_pick
+        return order, dir_pick, sticky
 
     def plan_route(self, prompt: Sequence[int]) -> List[ReplicaState]:
         """Candidate replicas in try-order (see _plan)."""
@@ -429,13 +729,19 @@ class Router:
                 return True, ""
         return False, "no ready replicas"
 
-    def _fetch(self, r: ReplicaState, path: str) -> Optional[str]:
+    def _fetch(self, r: ReplicaState, path: str,
+               timeout: Optional[float] = None) -> Optional[str]:
         """GET `path` from a replica, body text on 200 else None. Runs
         on handler threads with NO router lock held (network under the
-        lock is forbidden — see self._lock's comment)."""
+        lock is forbidden — see self._lock's comment). `timeout`
+        defaults to the proxy connect timeout; aggregation routes pass
+        `scrape_timeout_s` so one hung replica delays, not stalls,
+        the merge."""
         try:
-            conn = HTTPConnection(r.host, r.port,
-                                  timeout=self.connect_timeout_s)
+            conn = HTTPConnection(
+                r.host, r.port,
+                timeout=self.connect_timeout_s if timeout is None
+                else timeout)
             try:
                 conn.request("GET", path)
                 resp = conn.getresponse()
@@ -455,7 +761,7 @@ class Router:
         own ptpu_router_scrape_age_seconds)."""
         expositions: Dict[str, str] = {}
         for r in self.replicas:
-            text = self._fetch(r, "/metrics")
+            text = self._fetch(r, "/metrics", timeout=self.scrape_timeout_s)
             if text is not None:
                 expositions[r.url] = text
         return 200, CONTENT_TYPE, federate(expositions).encode()
@@ -471,7 +777,9 @@ class Router:
         if own is not None:
             fragments.append(("router", own))
         for r in self.replicas:
-            text = self._fetch(r, "/trace/" + tid) if tid else None
+            text = (self._fetch(r, "/trace/" + tid,
+                                timeout=self.scrape_timeout_s)
+                    if tid else None)
             if text is None:
                 continue
             try:
@@ -500,13 +808,19 @@ class Router:
                 "scrape_age_s": (round(now - r.last_scrape, 3)
                                  if r.last_scrape else None),
                 "prefixes": len(r.prefixes),
+                "breaker": r.breaker,
+                "fails": r.fails,
+                "registered": r.registered,
+                "ttft_p95_ms": r.ttft_p95_ms,
             } for r in self.replicas]
             inflight = self._inflight
             draining = self._draining
         return {"replicas": replicas, "inflight": inflight,
                 "draining": draining,
                 "scrape_interval_s": self.scrape_interval_s,
-                "directory_enabled": self.enable_directory}
+                "directory_enabled": self.enable_directory,
+                "retry_budget_tokens": self.retry_budget.tokens(),
+                "hedge_enabled": self.enable_hedge}
 
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
         resp = obs_response(
@@ -541,7 +855,11 @@ class Router:
             pass
 
     def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
-        if h.path.split("?")[0] != "/v1/completions":
+        path = h.path.split("?")[0]
+        if path == "/register":
+            self._handle_register(h)
+            return
+        if path != "/v1/completions":
             self._handle_get(h)         # reuse the 404 path
             return
         if self._draining:
@@ -560,14 +878,14 @@ class Router:
         rid = next(self._trace_seq)
         self.tracer.set_trace_id(rid, tid)
         self.tracer.span_begin(rid, "route")
-        candidates, dir_pick = self._plan(prompt)
+        candidates, dir_pick, sticky = self._plan(prompt)
         if not candidates:
             self.tracer.on_finish(rid, "shed")
             self._shed(h, "no_replica")
             return
         self._track_inflight(+1)
         try:
-            self._proxy(h, raw, prompt, candidates, dir_pick,
+            self._proxy(h, raw, prompt, candidates, dir_pick, sticky,
                         tid=tid, rid=rid)
         finally:
             self._track_inflight(-1)
@@ -582,72 +900,283 @@ class Router:
             self._inflight += delta
             self._m_inflight.set(float(self._inflight))
 
+    # -- proxy data path --------------------------------------------------
+    def _connect_stream(self, r: ReplicaState, raw: bytes,
+                        headers: dict):
+        """POST the completion to one replica.
+        ("ok", conn, resp) | ("shed", body) | ("error",)."""
+        try:
+            conn = HTTPConnection(r.host, r.port,
+                                  timeout=self.connect_timeout_s)
+            conn.request(
+                "POST", "/v1/completions", body=raw, headers=headers)
+            resp = conn.getresponse()
+        except OSError as e:
+            self._note_failure(r, f"connect failed: {e}")
+            return ("error",)
+        if resp.status == 503:      # replica shed: caller tries the next
+            body = resp.read()
+            conn.close()
+            return ("shed", body)
+        return ("ok", conn, resp)
+
+    def _hedge_delay_s(self, r: ReplicaState) -> float:
+        """How long to give `r`'s first response byte before hedging:
+        hedge_ttft_mult x its scraped TTFT p95 (fleet max when `r` has
+        no samples yet), clamped to [hedge_min_s, hedge_max_s]. An
+        unmeasured fleet waits the full hedge_max_s — no speculative
+        traffic before there is evidence of what slow means."""
+        with self._lock:
+            p95 = r.ttft_p95_ms or max(
+                (x.ttft_p95_ms for x in self.replicas if x.ready),
+                default=0.0)
+        if p95 <= 0:
+            return self.hedge_max_s
+        return min(max(self.hedge_ttft_mult * p95 / 1000.0,
+                       self.hedge_min_s), self.hedge_max_s)
+
+    def _open_stream(self, r: ReplicaState, raw: bytes, headers: dict,
+                     hedge_pool: Optional[List[ReplicaState]],
+                     rid: Optional[int]):
+        """Open the stream on `r`; with a non-empty `hedge_pool`, race
+        ONE hedge to its head after the TTFT-derived delay — first
+        response wins, the loser's connection is closed (the engine
+        behind it cancels and frees KV). The hedge spends a retry-
+        budget token when it fires; an empty bucket silently skips it.
+        Returns ("ok", replica, conn, resp) | ("shed", body) |
+        ("error",)."""
+        if not hedge_pool:
+            res = self._connect_stream(r, raw, headers)
+            return res if res[0] != "ok" else ("ok", r, res[1], res[2])
+        delay = self._hedge_delay_s(r)
+        results: "queue.Queue" = queue.Queue()
+        decided = threading.Event()
+        fired = threading.Event()
+        hedge_target = hedge_pool[0]
+
+        def attempt(rep: ReplicaState, tag: str, wait_s: float) -> None:
+            if wait_s > 0.0 and decided.wait(wait_s):
+                return                  # first answered before the delay
+            if tag == "hedge":
+                if not self.retry_budget.try_spend("router_hedge"):
+                    self._m_hedges.labels(outcome="denied").inc()
+                    results.put((tag, rep, ("error",)))
+                    return
+                fired.set()
+                if rid is not None:
+                    self.tracer.mark(rid, "hedge_fired", replica=rep.url)
+            results.put((tag, rep, self._connect_stream(rep, raw, headers)))
+
+        threads = [
+            threading.Thread(target=attempt, args=(r, "first", 0.0),
+                             daemon=True),
+            threading.Thread(target=attempt,
+                             args=(hedge_target, "hedge", delay),
+                             daemon=True)]
+        for t in threads:
+            t.start()
+        chosen = None
+        first_failure = None
+        outstanding = 2
+        overall = self.connect_timeout_s + delay + 1.0
+        endline = time.monotonic() + overall
+        while outstanding > 0 and chosen is None:
+            try:
+                tag, rep, res = results.get(
+                    timeout=max(0.1, endline - time.monotonic()))
+            except queue.Empty:
+                break
+            outstanding -= 1
+            if res[0] == "ok":
+                chosen = (tag, rep, res)
+            elif tag == "first":
+                first_failure = res
+                if not fired.is_set():
+                    # the primary failed before any hedge went out:
+                    # cancel the sleeping hedge and fail over normally
+                    decided.set()
+                    return first_failure
+            # a failed hedge: keep waiting for the primary
+        decided.set()
+        if chosen is None:
+            return first_failure if first_failure is not None else ("error",)
+        tag, rep, res = chosen
+        if tag == "hedge":
+            self._m_hedges.labels(outcome="won").inc()
+        elif fired.is_set():
+            self._m_hedges.labels(outcome="lost").inc()
+        if outstanding > 0:
+            # the loser is still connecting/streaming: reap its socket
+            # when it resolves so the engine behind it cancels
+            def reap(n: int) -> None:
+                for _ in range(n):
+                    try:
+                        _, _, late = results.get(
+                            timeout=self.connect_timeout_s + 5.0)
+                    except queue.Empty:
+                        return
+                    if late[0] == "ok":
+                        for obj in (late[2], late[1]):
+                            try:
+                                obj.close()
+                            except OSError:
+                                pass
+            threading.Thread(target=reap, args=(outstanding,),
+                             daemon=True).start()
+        return ("ok", rep, res[1], res[2])
+
+    def _client_write(self, h: BaseHTTPRequestHandler,
+                      data: bytes) -> bool:
+        try:
+            h.wfile.write(data)
+            h.wfile.flush()
+            return True
+        except OSError:
+            return False
+
+    def _relay_sse(self, h: BaseHTTPRequestHandler, resp,
+                   state: _RelayState) -> str:
+        """Frame-level relay: forward SSE frames as they arrive,
+        skipping the first `state.sent` data frames (a resumed stream
+        replays from the start — greedy decode on identical weights
+        makes the replay identical). Returns "done" ([DONE] relayed /
+        non-stream response fully copied), "client_gone" (our write
+        failed), or "truncated" (upstream died first — the caller
+        fails over)."""
+        ctype = resp.getheader("Content-Type", "") or ""
+        if resp.status != 200 or "text/event-stream" not in ctype:
+            if state.started:
+                return "truncated"  # can't splice a non-stream mid-stream
+            self._relay(h, resp)
+            return "done"
+        if not state.started:
+            try:
+                h.send_response(200)
+                h.send_header("Content-Type", ctype)
+                h.end_headers()
+            except OSError:
+                return "client_gone"
+            state.started = True
+        n = 0
+        try:
+            for payload in iter_sse(resp):
+                if payload == DONE_SENTINEL:
+                    if not self._client_write(h, sse_event(payload)):
+                        return "client_gone"
+                    return "done"
+                n += 1
+                if n <= state.sent:
+                    continue        # the client already has this frame
+                if not self._client_write(h, sse_event(payload)):
+                    return "client_gone"
+                state.sent = n
+        except OSError:             # read timeout / reset from upstream
+            pass
+        return "truncated"          # EOF without [DONE]
+
     def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
                prompt: Sequence[int],
                candidates: List[ReplicaState],
-               dir_pick: Optional[ReplicaState] = None, *,
+               dir_pick: Optional[ReplicaState] = None,
+               sticky: Optional[ReplicaState] = None, *,
                tid: Optional[str] = None,
                rid: Optional[int] = None) -> None:
-        """Try candidates in order; a refused connection or a 503 shed
-        moves to the next. The first streamable response is relayed
-        byte-for-byte (SSE frames pass through untouched). The served
-        replica's route kind: "primary" when it is the hash-sticky
-        pick (the directory agreeing with the hash stays "primary" so
-        stickiness verdicts survive), "directory" when the fleet
-        prefix directory OVERRODE the hash, "fallback" otherwise."""
-        sticky = self.replicas[prefix_shard(prompt, len(self.replicas),
-                                            self.prefix_len)]
+        """Drive one request to a `[DONE]`-terminated stream across as
+        many replicas as the retry budget allows: connect failures and
+        replica 503s fail over BEFORE the first byte; a mid-stream
+        death fails over WITH RESUME (state.sent frames are skipped on
+        the replay); the first attempt may hedge. Every re-attempt
+        after the first costs a budget token — an empty bucket sheds
+        503 reason="retry_budget" rather than storming a degraded
+        fleet. The served replica's route kind: "primary" when it is
+        the full-table hash pick (the directory agreeing with the hash
+        stays "primary" so stickiness verdicts survive), "directory"
+        when the fleet prefix directory OVERRODE the hash, "fallback"
+        otherwise."""
         headers = {"Content-Type": "application/json"}
         if tid:
             headers["x-ptpu-trace"] = tid
-        last_resp: Optional[Tuple[int, bytes]] = None
-        for r in candidates:
-            try:
-                conn = HTTPConnection(r.host, r.port,
-                                      timeout=self.connect_timeout_s)
-                conn.request(
-                    "POST", "/v1/completions", body=raw, headers=headers)
-                resp = conn.getresponse()
-            except OSError:
-                with self._lock:
-                    r.ready = False
-                    r.reason = "connect failed"
+        state = _RelayState()
+        pending = list(candidates)
+        last_shed: Optional[bytes] = None
+        attempt = 0
+        retry_kind = "connect"
+        while pending:
+            r = pending.pop(0)
+            attempt += 1
+            if attempt > 1:
+                if not self.retry_budget.try_spend("router"):
+                    if rid is not None:
+                        self.tracer.on_finish(rid, "budget_exhausted")
+                    if not state.started:
+                        self._shed(h, "retry_budget")
+                    return
+                self._m_retries.labels(kind=retry_kind).inc()
                 if rid is not None:
-                    self.tracer.mark(rid, "connect_failed", replica=r.url)
-                continue
-            if resp.status == 503:      # replica shed: try the next
-                last_resp = (503, resp.read())
-                conn.close()
+                    self.tracer.mark(rid, "failover", replica=r.url,
+                                     kind=retry_kind)
+            hedge_pool = (pending if attempt == 1 and self.enable_hedge
+                          and pending and not state.started else None)
+            res = self._open_stream(r, raw, headers, hedge_pool, rid)
+            if res[0] == "shed":
+                last_shed = res[1]
+                retry_kind = "shed"
                 if rid is not None:
                     self.tracer.mark(rid, "replica_shed", replica=r.url)
                 continue
-            if r is sticky:
+            if res[0] == "error":
+                retry_kind = "connect"
+                if rid is not None:
+                    self.tracer.mark(rid, "connect_failed", replica=r.url)
+                continue
+            _, r_used, conn, resp = res
+            if r_used is not r:
+                # the hedge won: it came out of pending; the slow
+                # primary goes to the back as a last-resort retry
+                if r_used in pending:
+                    pending.remove(r_used)
+                pending.append(r)
+            if r_used is sticky:
                 kind = "primary"
-            elif dir_pick is not None and r is dir_pick:
+            elif dir_pick is not None and r_used is dir_pick:
                 kind = "directory"
             else:
                 kind = "fallback"
-            if dir_pick is not None and r is dir_pick:
+            if dir_pick is not None and r_used is dir_pick:
                 self._m_dir_hits.inc()
-            self._m_routed.labels(replica=r.url, kind=kind).inc()
+            self._m_routed.labels(replica=r_used.url, kind=kind).inc()
             if rid is not None:
-                self.tracer.mark(rid, "routed", replica=r.url, kind=kind)
+                self.tracer.mark(rid, "routed", replica=r_used.url,
+                                 kind=kind)
                 self.tracer.span_begin(rid, "relay")
-            self._relay(h, resp)
+            outcome = self._relay_sse(h, resp, state)
             conn.close()
+            if outcome == "done":
+                if rid is not None:
+                    self.tracer.on_finish(rid, "relayed")
+                return
+            if outcome == "client_gone":
+                if rid is not None:
+                    self.tracer.on_finish(rid, "client_gone")
+                return
+            # upstream died mid-stream: breaker takes note, the next
+            # candidate resumes past the frames the client already has
+            self._note_failure(r_used, "stream truncated")
+            retry_kind = "stream"
             if rid is not None:
-                self.tracer.on_finish(rid, "relayed")
-            return
+                self.tracer.mark(rid, "stream_truncated",
+                                 replica=r_used.url, frames=state.sent)
         if rid is not None:
             self.tracer.on_finish(rid, "shed")
-        if last_resp is not None:       # every replica shed: relay it
-            status, body = last_resp
+        if state.started:
+            return      # partial stream, nothing left to resume from
+        if last_shed is not None:       # every replica shed: relay it
             try:
-                h.send_response(status)
+                h.send_response(503)
                 h.send_header("Content-Type", "application/json")
-                h.send_header("Content-Length", str(len(body)))
+                h.send_header("Content-Length", str(len(last_shed)))
                 h.end_headers()
-                h.wfile.write(body)
+                h.wfile.write(last_shed)
             except (BrokenPipeError, ConnectionResetError):
                 pass
             return
@@ -658,7 +1187,9 @@ class Router:
         """Copy status + content-type + body bytes to the client,
         unbuffered per read so tokens stream as they arrive. A client
         write failure closes the replica socket (via the caller's
-        conn.close()), which cancels the request engine-side."""
+        conn.close()), which cancels the request engine-side. The
+        non-SSE path (errors, future non-stream responses); SSE goes
+        through _relay_sse for failover-with-resume."""
         try:
             h.send_response(resp.status)
             ctype = resp.getheader("Content-Type", "application/octet-stream")
@@ -676,26 +1207,57 @@ class Router:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
-    """`python -m paddle_tpu.serve.router --replica URL --replica URL`"""
+    """`python -m paddle_tpu.serve.router --replica URL --replica URL`
+    (or no --replica at all: replicas join via POST /register)"""
     import argparse
 
     p = argparse.ArgumentParser(description="ptpu serve router")
-    p.add_argument("--replica", action="append", required=True,
-                   help="replica base url (repeatable)")
+    p.add_argument("--replica", action="append", default=[],
+                   help="replica base url (repeatable; optional — "
+                        "replicas can also POST /register themselves)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--prefix-len", type=int, default=32)
     p.add_argument("--scrape-interval-s", type=float, default=0.5)
+    p.add_argument("--scrape-timeout-s", type=float, default=2.0,
+                   help="per-replica scrape socket timeout: a wedged "
+                        "replica delays only itself, never the loop")
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
     p.add_argument("--no-prefix-directory", action="store_true",
                    help="route on hash stickiness only; ignore the "
                         "scraped /kvprefixes fleet directory")
+    p.add_argument("--breaker-fails", type=int, default=3,
+                   help="consecutive scrape/connect failures that open "
+                        "a replica's circuit breaker (evict)")
+    p.add_argument("--breaker-open-s", type=float, default=2.0,
+                   help="how long an open breaker waits before its "
+                        "half-open probe")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.2,
+                   help="retry tokens deposited per successful request")
+    p.add_argument("--retry-budget-burst", type=float, default=16.0,
+                   help="retry-budget bucket size (cold-start allowance)")
+    p.add_argument("--no-hedge", action="store_true",
+                   help="disable hedged requests")
+    p.add_argument("--hedge-ttft-mult", type=float, default=3.0,
+                   help="hedge after this multiple of the scraped "
+                        "TTFT p95")
+    p.add_argument("--hedge-min-s", type=float, default=0.05)
+    p.add_argument("--hedge-max-s", type=float, default=2.0)
     a = p.parse_args(argv)
     router = Router(a.replica, host=a.host, port=a.port,
                     prefix_len=a.prefix_len,
                     scrape_interval_s=a.scrape_interval_s,
+                    scrape_timeout_s=a.scrape_timeout_s,
                     drain_deadline_s=a.drain_deadline_s,
-                    enable_directory=not a.no_prefix_directory)
+                    enable_directory=not a.no_prefix_directory,
+                    breaker_fails=a.breaker_fails,
+                    breaker_open_s=a.breaker_open_s,
+                    retry_budget_ratio=a.retry_budget_ratio,
+                    retry_budget_burst=a.retry_budget_burst,
+                    enable_hedge=not a.no_hedge,
+                    hedge_ttft_mult=a.hedge_ttft_mult,
+                    hedge_min_s=a.hedge_min_s,
+                    hedge_max_s=a.hedge_max_s)
     router.start().install_signals()
     code = router.wait()
     router.stop()

@@ -8,14 +8,27 @@ fault-free twin, then checks the acceptance property: the stitched loss
 curve equals the fault-free curve bit-for-bit and the run never aborts
 while an intact checkpoint exists.
 
+The FLEET cells extend the matrix to the serving side: a live
+2-replica fleet with one replica behind a NetChaosProxy
+(resilience/chaos.py), one cell per wire-fault mode (connect refusal,
+503 burst, sustained black-hole, slow first byte) armed on the sticky
+primary's path. Columns: failed_requests / truncated_streams (both
+must be 0 — breaker failover, stream resume, and hedging absorb the
+fault), retry_ratio (token-budget capped), and evicted/rejoined
+membership events (the sustained black-hole must trip the breaker and,
+after heal, rejoin through the half-open probe).
+
 One JSON line per cell on stdout:
 
     {"cell": "sigterm@4", "mode": "cluster", "ok": true, ...}
+    {"cell": "fleet:blackhole", "mode": "fleet", "ok": true, ...}
 
 Exit code: 0 iff every cell is ok. The fast in-process subset of this
-grid runs in tier-1 as tests/test_chaos.py (`chaos` marker).
+grid runs in tier-1 as tests/test_chaos.py (`chaos` marker); the fleet
+cells' in-process twin is tests/test_fleet_ft.py (`serve` marker).
 
 Run: python tools/chaos_sweep.py [--steps 8] [--inprocess-only]
+     [--no-fleet]
 """
 
 import argparse
@@ -227,10 +240,166 @@ def run_inprocess_grid(tmp, steps):
     return oks
 
 
+# -- fleet cells (serving fleet under wire faults) ---------------------------
+
+def _fleet_member(router, url):
+    for r in router.replicas:
+        if r.url == url:
+            return r
+    return None
+
+
+def _fleet_tallies(router):
+    """Router-side counters the fleet columns difference against."""
+    retr = router.obs.get("ptpu_router_retries_total")
+    mem = router.obs.get("ptpu_router_membership_events_total")
+    return {"retries": sum(retr.labels(kind=k).value
+                           for k in ("connect", "shed", "stream")),
+            "evicts": mem.labels(event="evict").value,
+            "rejoins": mem.labels(event="rejoin").value}
+
+
+def run_fleet_grid():
+    """The net-chaos matrix over a LIVE serving fleet: two replica
+    subprocesses, one reached through a NetChaosProxy, a Router over
+    both. Each cell arms one wire-fault mode (resilience/chaos.py),
+    drives requests whose sticky shard IS the faulted replica, then
+    heals. Columns per cell: failed_requests (client 5xx — must be 0),
+    truncated_streams (SSE without [DONE] — must be 0), retry_ratio
+    (budget-capped), evicted/rejoined (breaker membership events; the
+    sustained black-hole MUST evict and, after heal, rejoin)."""
+    import threading  # noqa: F401  (parity with serve_bench helpers)
+    import time
+
+    from serve_bench import _spawn_replica, _terminate
+    from paddle_tpu.resilience.chaos import NetChaosProxy
+    from paddle_tpu.serve.router import Router, prefix_shard
+    from paddle_tpu.serve.sse import collect_stream
+
+    proc_a, base_a = _spawn_replica()
+    proc_b, base_b = _spawn_replica()
+    proxy = NetChaosProxy(upstream_port=int(base_b.rsplit(":", 1)[1]))
+    proxy.start()
+    proxy_url = f"http://127.0.0.1:{proxy.port}"
+    router = Router([base_a, proxy_url], prefix_len=8,
+                    scrape_interval_s=0.2, scrape_timeout_s=0.5,
+                    connect_timeout_s=1.5, breaker_fails=2,
+                    breaker_open_s=0.4, retry_budget_ratio=0.5,
+                    retry_budget_burst=8.0, hedge_max_s=0.8).start()
+
+    def wait_whole(timeout_s=15.0):
+        """Both members ready with closed breakers (fleet healed)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(r.ready and r.breaker == "closed"
+                   for r in router.replicas):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def prompts_for(cell_idx):
+        """4 FRESH prompts whose sticky shard is the PROXIED replica
+        (table index 1): the armed fault must sit on the primary
+        path, and the prompts must be new to the fleet — a prompt a
+        previous cell already served would be directory-routed to the
+        warm survivor and never touch the fault at all."""
+        out, seed = [], 100 * cell_idx
+        while len(out) < 4:
+            cand = [seed % 53, (seed * 7 + 1) % 53, seed % 11,
+                    (seed * 3 + 2) % 29] * 2
+            if prefix_shard(cand, 2, 8) == 1:
+                out.append(cand + [40 + len(out)])
+            seed += 1
+        return out
+
+    def wait_evicted(timeout_s=8.0):
+        """Breaker OPEN on the proxied member (sustained-fault gate)."""
+        m = _fleet_member(router, proxy_url)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if m.breaker == "open":
+                return True
+            time.sleep(0.02)
+        return False
+
+    default_slow_ms = proxy.slow_ms
+    grid = [("refuse", 2, {}),
+            ("http_503", 2, {}),
+            ("blackhole", 1 << 30, {}),
+            ("slow", 4, {"slow_ms": 300})]
+    oks = []
+    try:
+        for idx, (mode, n, attrs) in enumerate(grid):
+            name = f"fleet:{mode}"
+            if not wait_whole():
+                print(json.dumps({"cell": name, "mode": "fleet",
+                                  "ok": False,
+                                  "error": "fleet never became whole"}))
+                oks.append(False)
+                continue
+            before = _fleet_tallies(router)
+            for k, v in attrs.items():
+                setattr(proxy, k, v)
+            proxy.arm(mode, n)
+            try:
+                results = [collect_stream(router.url,
+                                          {"prompt": p,
+                                           "max_new_tokens": 8},
+                                          timeout=60)
+                           for p in prompts_for(idx)]
+                if mode == "blackhole":
+                    # sustained fault: the scrape loop must breaker-
+                    # evict the member BEFORE the wire heals
+                    wait_evicted()
+            finally:
+                proxy.heal()
+                proxy.slow_ms = default_slow_ms
+            # a sustained fault must have tripped the breaker before
+            # heal; every mode must leave the fleet whole again after
+            recovered = wait_whole()
+            after = _fleet_tallies(router)
+            failed = sum(1 for r in results if r["status"] != 200)
+            truncated = sum(1 for r in results
+                            if r["status"] == 200 and not r["done"])
+            successes = len(results) - failed
+            retries = after["retries"] - before["retries"]
+            ratio = retries / max(1, successes)
+            cap = (router.retry_budget.burst
+                   + router.retry_budget.ratio * successes)
+            evicted = after["evicts"] - before["evicts"]
+            rejoined = after["rejoins"] - before["rejoins"]
+            ok = bool(failed == 0 and truncated == 0
+                      and retries <= cap and recovered
+                      and (mode != "blackhole"
+                           or (evicted >= 1 and rejoined >= 1)))
+            print(json.dumps({"cell": name, "mode": "fleet",
+                              "ok": ok, "failed_requests": failed,
+                              "truncated_streams": truncated,
+                              "retry_ratio": round(ratio, 4),
+                              "retries": retries,
+                              "evicted": evicted, "rejoined": rejoined,
+                              "recovered": recovered}))
+            oks.append(ok)
+    except Exception as e:    # a cell must never take the sweep down
+        print(json.dumps({"cell": "fleet_grid", "mode": "fleet",
+                          "ok": False,
+                          "error": f"{type(e).__name__}: {e}"}))
+        oks.append(False)
+    finally:
+        router.stop()
+        proxy.stop()
+        _terminate(proc_a)
+        _terminate(proc_b)
+    return oks
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--inprocess-only", action="store_true")
+    ap.add_argument("--no-fleet", action="store_true",
+                    help="skip the serving-fleet wire-fault cells "
+                         "(they boot replica subprocesses)")
     ap.add_argument("--tmp", default=None, help="scratch dir (default mkdtemp)")
     args = ap.parse_args()
 
@@ -242,6 +411,8 @@ def main():
     if not args.inprocess_only:
         oks += run_cluster_grid(tmp, args.steps)
     oks += run_inprocess_grid(tmp, args.steps)
+    if not args.inprocess_only and not args.no_fleet:
+        oks += run_fleet_grid()
     ok = all(o for o in oks if o is not None)
     print(json.dumps({"cell": "TOTAL", "ok": bool(ok),
                       "cells": len(oks), "failed": sum(o is False for o in oks)}))

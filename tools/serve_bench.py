@@ -1,6 +1,6 @@
 """Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
-Nine scenarios, each an acceptance property of the serving stack
+Ten scenarios, each an acceptance property of the serving stack
 (ENGINE.md / OBSERVABILITY.md). The in-process scenarios run on the
 SAME model with EXACT token identity (greedy decode — the engine's
 batching/sharing/chunking invariance makes identity, not closeness,
@@ -79,6 +79,22 @@ drives them over HTTP:
            admission control sheds nothing at nominal load, sheds
            nonzero (reason slo_*) under 2x overload, and keeps the
            admitted p99 TTFT under the configured deadline.
+- fleet_chaos: fleet fault tolerance (RESILIENCE.md). A third replica
+           joins a live 2-replica fleet by REGISTRATION (POST
+           /register heartbeat, not router argv); under live mixed
+           traffic one replica is SIGKILLed and another black-holed
+           at the wire (resilience/chaos.py NetChaosProxy) — every
+           client stream must still finish 200/[DONE] at full length
+           (breaker failover + stream resume + hedging, retries paid
+           from the router's token budget), the dead replica must be
+           breaker-evicted within 3 scrape intervals; then the wire
+           heals (half-open rejoin) and the killed replica restarts
+           on the same --tier-spill-dir: it must re-register under
+           its new port, warm-start the host KV tier from the
+           periodic spill snapshot, and serve a directory-routed
+           warm hit byte-identical to the cold pass with revived
+           (not re-prefilled) blocks — compile gauge pinned at 1 on
+           every replica throughout.
 
 Verdict inputs come from the metrics REGISTRY (paddle_tpu/obs/) — the
 same TTFT/TPOT/hit-rate/step-latency series a production scrape reads
@@ -94,7 +110,8 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 Exit code: 0 iff every scenario's verdict holds.
 
 Run: python tools/serve_bench.py
-     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|tp|router]
+     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|tp|
+                 router|fleet_chaos]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
      [--trace-out FILE]     # dump the last in-process verdict engine's
@@ -1353,12 +1370,291 @@ def scenario_router(model, variables, args):
     return ok
 
 
+# -- scenario: fleet_chaos — kill + black-hole a live fleet ----------------
+
+def _wait_for(pred, timeout_s, interval_s=0.02):
+    """Poll `pred` until truthy; returns (value, elapsed_s) — value is
+    falsy on timeout."""
+    t0 = time.monotonic()
+    while True:
+        v = pred()
+        if v:
+            return v, time.monotonic() - t0
+        if time.monotonic() - t0 > timeout_s:
+            return v, time.monotonic() - t0
+        time.sleep(interval_s)
+
+
+def _member(router, url):
+    for r in router.replicas:
+        if r.url == url:
+            return r
+    return None
+
+
+def _router_counts(router):
+    """(client-visible successes routed, retries by kind, hedges won)."""
+    routed_fam = router.obs.get("ptpu_router_requests_total")
+    routed = sum(routed_fam.labels(replica=r.url, kind=k).value
+                 for r in router.replicas
+                 for k in ("primary", "directory", "fallback"))
+    retr_fam = router.obs.get("ptpu_router_retries_total")
+    retries = {k: retr_fam.labels(kind=k).value
+               for k in ("connect", "shed", "stream")}
+    hedges = router.obs.get(
+        "ptpu_router_hedges_total").labels(outcome="won").value
+    return routed, retries, hedges
+
+
+def _phase_fleet_assemble(args, router, base_c, spill_dir):
+    """Replica C is NOT on the router's argv: it must join by
+    registration heartbeat. Then warm C's host KV tier directly (cold
+    generation + churn past the tiny block pool demotes the warm
+    prefix to host RAM) and wait for a periodic spill snapshot so a
+    later SIGKILL still leaves a warm-restart image on disk."""
+    from paddle_tpu.serve.sse import collect_stream
+
+    joined, join_s = _wait_for(
+        lambda: (m := _member(router, base_c)) is not None and m.ready, 20)
+    registers = router.obs.get(
+        "ptpu_router_membership_events_total").labels(
+            event="register").value
+
+    # the warm workload mirrors the tier tests: a fixed system prefix
+    # plus tail, then filler churn that overflows the 10-block pool
+    warm_prompt = ([7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]
+                   + [21, 22, 23, 24])
+    cold = collect_stream(base_c, {"prompt": warm_prompt,
+                                   "max_new_tokens": 16}, timeout=60)
+    for i in range(2):
+        collect_stream(base_c, {"prompt": [50 + i] * 16,
+                                "max_new_tokens": 16}, timeout=60)
+    spilled, spill_s = _wait_for(
+        lambda: (os.path.exists(os.path.join(spill_dir, "tier-spill.json"))
+                 and _scrape(base_c).get(
+                     "ptpu_kv_tier_spill_saved_blocks_total", 0.0) > 0),
+        20)
+    tiered = _scrape(base_c).get("ptpu_kv_tier_entries", 0.0)
+    emit({"cell": "fleet_assemble", "joined": bool(joined),
+          "join_s": round(join_s, 3), "register_events": registers,
+          "cold_tokens": len(cold["tokens"]),
+          "tier_entries": tiered, "spill_on_disk": bool(spilled),
+          "spill_wait_s": round(spill_s, 3)})
+    ok = bool(joined and registers >= 1 and cold["status"] == 200
+              and cold["done"] and tiered > 0 and spilled)
+    return ok, {"cold": cold, "warm_prompt": warm_prompt,
+                "register_events": registers}
+
+
+def _phase_fleet_chaos(args, router, proc_c, base_c, proxy, rng, systems):
+    """Live mixed traffic through the router while one replica is
+    SIGKILLed and another black-holed at the wire: every client stream
+    must still finish 200/[DONE] at full length (failover + resume +
+    hedging, retries paid from the budget), and the killed replica
+    must be breaker-evicted within 3 scrape intervals."""
+    from paddle_tpu.serve.sse import collect_stream
+
+    n_tokens = 2 * args.router_new_tokens
+    n_streams = 6 * args.router_groups
+    prompts = [systems[i % len(systems)]
+               + rng.integers(0, _REPLICA_VOCAB - 1, 4).tolist()
+               for i in range(n_streams)]
+    results, lock = [], threading.Lock()
+
+    def fire(p):
+        out = collect_stream(router.url,
+                             {"prompt": p, "max_new_tokens": n_tokens},
+                             timeout=60)
+        with lock:
+            results.append(out)
+
+    threads = []
+    t_kill = evict_s = None
+    for i, p in enumerate(prompts):
+        t = threading.Thread(target=fire, args=(p,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.08)
+        if i == n_streams // 4:
+            # mid-traffic: SIGKILL the tiered replica (no drain, no
+            # goodbye — the periodic spill is all that survives) and
+            # black-hole every NEW connection to the proxied replica
+            proc_c.kill()
+            proxy.arm("blackhole")
+            t_kill = time.monotonic()
+            evicted, evict_s = _wait_for(
+                lambda: _member(router, base_c).breaker == "open",
+                timeout_s=10, interval_s=0.01)
+    for t in threads:
+        t.join(timeout=120)
+    proc_c.wait(timeout=30)
+
+    failed = sum(1 for r in results if r["status"] != 200)
+    truncated = sum(1 for r in results
+                    if r["status"] == 200 and not r["done"])
+    short = sum(1 for r in results
+                if r["done"] and len(r["tokens"]) != n_tokens)
+    routed, retries, hedges_won = _router_counts(router)
+    retries_total = sum(retries.values())
+    successes = len(results) - failed
+    retry_ratio = retries_total / max(1, successes)
+    # the budget's own invariant: spends never exceed burst + deposits
+    cap = (router.retry_budget.burst
+           + router.retry_budget.ratio * successes)
+    evict_budget_s = 3 * router.scrape_interval_s
+    evicted_in_time = (evict_s is not None
+                       and evict_s <= evict_budget_s)
+    emit({"cell": "fleet_chaos_traffic", "streams": len(results),
+          "failed_requests": failed, "truncated_streams": truncated,
+          "short_streams": short, "retries": retries,
+          "retry_ratio": round(retry_ratio, 4),
+          "retry_cap": round(cap / max(1, successes), 4),
+          "hedges_won": hedges_won,
+          "evict_s": round(evict_s, 3) if evict_s is not None else None,
+          "evict_budget_s": evict_budget_s})
+    ok = bool(t_kill is not None and len(results) == n_streams
+              and failed == 0 and truncated == 0 and short == 0
+              and retries_total <= cap and evicted_in_time)
+    return ok, {"failed_requests": failed,
+                "truncated_streams": truncated,
+                "retry_ratio": round(retry_ratio, 4),
+                "evict_s": round(evict_s, 3) if evict_s is not None
+                else None}
+
+
+def _phase_fleet_rejoin(args, router, proxy, base_a, base_b, spill_dir,
+                        warm):
+    """Heal the wire, restart the killed replica on the same spill
+    dir: the black-holed replica must rejoin through its half-open
+    probe, the restart must re-register under its NEW port, warm-start
+    the host tier from disk, and serve a directory-routed warm hit —
+    byte-identical to the cold pass, revived (not re-prefilled), with
+    the compile gauge still 1 everywhere."""
+    from paddle_tpu.serve.sse import collect_stream
+
+    proxy.heal()
+    rejoined, rejoin_s = _wait_for(
+        lambda: (m := _member(router, proxy.url)) is not None and m.ready,
+        20)
+    rejoin_events = router.obs.get(
+        "ptpu_router_membership_events_total").labels(event="rejoin").value
+
+    proc_c2, base_c2 = _spawn_replica(extra=(
+        "--num-blocks", "10", "--host-tier-bytes", str(1 << 20),
+        "--tier-spill-dir", spill_dir, "--tier-spill-interval-s", "0.2",
+        "--router-url", router.url, "--register-interval-s", "0.1",
+        "--dir-interval-s", "0.1"))
+    dir_hits0 = router.obs.get("ptpu_router_directory_hits_total").value
+    try:
+        # ready AND advertising its warm-started prefixes to the
+        # directory — only then can the router route the warm hit home
+        advertised, adv_s = _wait_for(
+            lambda: (m := _member(router, base_c2)) is not None
+            and m.ready and m.prefixes, 30)
+        boot = _scrape(base_c2)
+        out = collect_stream(router.url,
+                             {"prompt": warm["warm_prompt"],
+                              "max_new_tokens": 16}, timeout=60)
+        after = _scrape(base_c2)
+        dir_hits = router.obs.get(
+            "ptpu_router_directory_hits_total").value - dir_hits0
+        compiles = {u: _scrape(u).get("ptpu_engine_compiles")
+                    for u in (base_a, base_b, base_c2)}
+    finally:
+        exit_c2 = _terminate(proc_c2)
+    emit({"cell": "fleet_rejoin",
+          "blackholed_rejoined": bool(rejoined),
+          "rejoin_s": round(rejoin_s, 3), "rejoin_events": rejoin_events,
+          "restart_url": base_c2, "advertise_s": round(adv_s, 3),
+          "spill_loaded_blocks":
+              boot.get("ptpu_kv_tier_spill_loaded_blocks_total", 0.0),
+          "warm_status": out["status"],
+          "warm_tokens_identical":
+              bool(out["tokens"] == warm["cold"]["tokens"]),
+          "directory_hits": dir_hits,
+          "revived_blocks":
+              after.get("ptpu_kv_tier_revived_blocks_total", 0.0),
+          "compiles": compiles, "restart_exit": exit_c2})
+    ok = bool(rejoined and rejoin_events >= 1 and advertised
+              and boot.get("ptpu_kv_tier_spill_loaded_blocks_total",
+                           0.0) > 0
+              and out["status"] == 200 and out["done"]
+              and out["tokens"] == warm["cold"]["tokens"]
+              and dir_hits >= 1
+              and after.get("ptpu_kv_tier_revived_blocks_total", 0.0) > 0
+              and all(c == 1.0 for c in compiles.values())
+              and exit_c2 == 75)
+    return ok, {"rejoined": bool(rejoined), "directory_hits": dir_hits,
+                "warm_identical":
+                    bool(out["tokens"] == warm["cold"]["tokens"])}
+
+
+def scenario_fleet_chaos(model, variables, args):
+    """Fleet fault tolerance end to end (RESILIENCE.md): a 3-replica
+    fleet assembled by registration, then SIGKILL + wire black-hole
+    under live traffic — zero failed or truncated client streams,
+    breaker eviction within 3 scrape intervals, budgeted retries —
+    then heal/restart: half-open rejoin, re-registration, host-tier
+    warm start from the periodic spill, and a directory-routed warm
+    hit. Compile gauge 1 on every replica throughout."""
+    del model, variables
+    from paddle_tpu.resilience.chaos import NetChaosProxy
+    from paddle_tpu.serve.router import Router
+
+    rng = np.random.default_rng(11)
+    systems = [rng.integers(0, _REPLICA_VOCAB - 1,
+                            args.router_system_len).tolist()
+               for _ in range(args.router_groups)]
+    spill_dir = tempfile.mkdtemp(prefix="ptpu-fleet-spill-")
+
+    proc_a, base_a = _spawn_replica()
+    proc_b, base_b = _spawn_replica()
+    proxy = NetChaosProxy(upstream_port=int(base_b.rsplit(":", 1)[1]))
+    proxy.start()
+    proxy.url = f"http://127.0.0.1:{proxy.port}"
+    router = Router([base_a, proxy.url],
+                    prefix_len=args.router_system_len,
+                    scrape_interval_s=0.25, scrape_timeout_s=0.5,
+                    connect_timeout_s=2.0,
+                    breaker_fails=2, breaker_open_s=0.5,
+                    retry_budget_ratio=0.5, retry_budget_burst=8.0,
+                    hedge_max_s=1.0).start()
+    # replica C joins via registration, not argv: a tiny block pool +
+    # host tier + periodic spill make it the warm-restart victim
+    proc_c, base_c = _spawn_replica(extra=(
+        "--num-blocks", "10", "--host-tier-bytes", str(1 << 20),
+        "--tier-spill-dir", spill_dir, "--tier-spill-interval-s", "0.2",
+        "--router-url", router.url, "--register-interval-s", "0.1",
+        "--dir-interval-s", "0.1"))
+    try:
+        ok_asm, warm = _phase_fleet_assemble(args, router, base_c,
+                                             spill_dir)
+        ok_chaos, chaos = _phase_fleet_chaos(args, router, proc_c,
+                                             base_c, proxy, rng, systems)
+        ok_rejoin, rejoin = _phase_fleet_rejoin(args, router, proxy,
+                                                base_a, base_b,
+                                                spill_dir, warm)
+    finally:
+        router.stop()
+        proxy.stop()
+        for proc in (proc_a, proc_b, proc_c):
+            _terminate(proc)
+
+    ok = bool(ok_asm and ok_chaos and ok_rejoin)
+    emit({"cell": "fleet_chaos_verdict", "ok": ok,
+          "assemble_ok": ok_asm, "chaos_ok": ok_chaos,
+          "rejoin_ok": ok_rejoin,
+          "register_events": warm["register_events"],
+          **chaos, **rejoin})
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
                              "mixed", "spec", "nbest", "tiered", "tp",
-                             "router"])
+                             "router", "fleet_chaos"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -1412,7 +1708,8 @@ def main():
                  "chunked": scenario_chunked, "mixed": scenario_mixed,
                  "spec": scenario_spec, "nbest": scenario_nbest,
                  "tiered": scenario_tiered, "tp": scenario_tp,
-                 "router": scenario_router}
+                 "router": scenario_router,
+                 "fleet_chaos": scenario_fleet_chaos}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
