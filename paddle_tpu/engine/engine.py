@@ -169,7 +169,8 @@ class ServeEngine:
                  host_tier_bytes: int = 0,
                  kv_tier_int8: bool = False,
                  tier_spill_dir: Optional[str] = None,
-                 tp_size: int = 1):
+                 tp_size: int = 1,
+                 demote_finished: bool = False):
         self.model = model
         # telemetry (OBSERVABILITY.md): None -> the process registry /
         # a fresh tracer. serve_bench passes a private registry per
@@ -282,6 +283,13 @@ class ServeEngine:
         # interval, so the router's fleet directory finds them. A
         # missing/partial/foreign spill loads 0 blocks and the tier
         # simply starts cold.
+        # disaggregated serving (serve/kvxfer.py): a prefill-phase
+        # replica demotes every finished request's committed blocks
+        # into the host tier at _finish, so the prefix is advertised on
+        # /kvprefixes and PULLABLE over GET /kvblocks/<digest> by the
+        # decode replica that continues the stream. No-op without a
+        # tier; demotion is host-side numpy (one-compile safe).
+        self.demote_finished = bool(demote_finished)
         self.tier_spill_dir = tier_spill_dir
         if self.host_tier is not None and tier_spill_dir:
             loaded = self.host_tier.load_spill(tier_spill_dir)
@@ -962,6 +970,10 @@ class ServeEngine:
 
     def _finish(self, req: Request, reason: str) -> None:
         req.finish_time = time.monotonic()
+        if self.demote_finished and self.host_tier is not None:
+            # demote BEFORE the scheduler frees the blocks: the decode
+            # replica pulls exactly the prefix this request committed
+            self.cache.demote_sequence(req.req_id, reason="finish")
         self.scheduler.finish(req, reason)
         self.finished[req.req_id] = req
         ttft_ms = (req.first_token_time - req.enqueue_time) * 1e3

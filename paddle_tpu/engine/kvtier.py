@@ -100,7 +100,7 @@ class HostKVTier:
         self._c_demoted = reg.counter(
             "ptpu_kv_tier_demoted_blocks_total",
             "KV blocks copied out to the host tier",
-            labelnames=("reason",))          # reason=evict|preempt
+            labelnames=("reason",))     # reason=evict|preempt|finish
         self._c_revived = reg.counter(
             "ptpu_kv_tier_revived_blocks_total",
             "Host-tier blocks revived into the device pool")
@@ -215,6 +215,30 @@ class HostKVTier:
         if limit and len(keys) > limit:
             keys = keys[-limit:]
         return [(len(k), prefix_digest(k)) for k in keys]
+
+    def entry_by_digest(self, digest: str
+                        ) -> Optional[Tuple[tuple, list, int]]:
+        """Raw (key, blobs, nbytes) for the resident entry whose
+        content digest matches, or None — the `GET /kvblocks/<digest>`
+        lookup (serve/kvxfer.py). Blobs come back still encoded (int8
+        stays int8) and immutable, so the caller may serialize outside
+        the lock; the entry is NOT LRU-touched — a fleet pull must not
+        distort this replica's local heat ordering. Newest entries win
+        a (vanishingly unlikely) digest collision."""
+        with self._lock:
+            for key in reversed(self._entries):
+                if prefix_digest(key) == digest:
+                    ent = self._entries[key]
+                    return key, list(ent.blobs), ent.nbytes
+        return None
+
+    def insert_encoded(self, key: tuple, blobs: list, nbytes: int) -> bool:
+        """Insert an entry that is ALREADY in this tier's blob encoding
+        (the fleet KV-transfer pull path, serve/kvxfer.py): the wire
+        carries the source tier's raw blobs, so fp entries stay
+        bit-exact and int8 entries keep their original scales — revival
+        on this replica dequantizes identically to the source."""
+        return self._insert_raw(key, blobs, nbytes)
 
     # -- warm restarts: disk spill ----------------------------------------
     # Layout inside the spill dir (tier-spill.json commits LAST, so a

@@ -249,12 +249,15 @@ class PagedKVCache:
                   for kp, vp in self.pools]
         return self.host_tier.put(key, layers, reason=reason)
 
-    def demote_sequence(self, seq_id: int) -> int:
+    def demote_sequence(self, seq_id: int, reason: str = "preempt") -> int:
         """Copy a live sequence's committed full blocks out to the host
         tier — the preemption path: the scheduler calls this right
         before free_sequence so re-admission revives the context by DMA
         instead of re-prefilling it (quadratic recompute becomes a
-        linear copy). Returns blocks demoted."""
+        linear copy). A prefill-phase engine also calls it at request
+        FINISH (reason="finish") so a decode replica can pull the
+        finished prefix over the fleet KV-transfer plane
+        (serve/kvxfer.py). Returns blocks demoted."""
         if self.host_tier is None or not self.enable_prefix_cache:
             return 0
         table = self._tables.get(seq_id)
@@ -266,7 +269,7 @@ class PagedKVCache:
         count = 0
         for bi in range(self._committed.get(seq_id, 0) // bs):
             key = self._key_of.get(table[bi]) or tuple(toks[:(bi + 1) * bs])
-            if self._demote_block(table[bi], key, "preempt"):
+            if self._demote_block(table[bi], key, reason):
                 count += 1
         return count
 

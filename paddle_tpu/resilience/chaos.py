@@ -15,6 +15,8 @@ clusters can arm them without code changes:
     PTPU_CHAOS_NAN_ATTEMPTS=K   ...for the first K attempts at each step (dflt 1)
     PTPU_CHAOS_CORRUPT_STEP=S   corrupt ckpt-S right after it commits
     PTPU_CHAOS_CORRUPT_MODE=M   truncate (default) | manifest
+    PTPU_CHAOS_KVXFER_CORRUPT=N first N fleet KV-transfer blobs this
+                                process pulls arrive bit-rotted
 
 Wire-level faults ride the same contract through `NetChaosProxy` — an
 in-process TCP proxy a test or serve_bench parks in front of a
@@ -191,6 +193,30 @@ def maybe_corrupt_checkpoint(path: str, step: Optional[int]) -> None:
               else corrupt_truncate_shard(path))
     resilience_event("chaos_inject", site="corrupt", step=step,
                      mode=mode, file=os.path.basename(target))
+
+
+# -- fleet KV-transfer corruption (serve/kvxfer.py pull path) ---------------
+
+def maybe_corrupt_kvxfer(data: bytes) -> bytes:
+    """Flip bytes mid-payload in the first PTPU_CHAOS_KVXFER_CORRUPT
+    kv-transfer blobs THIS process pulls (serve/kvxfer.py calls it on
+    every fetched /kvblocks body) — bit rot on the fleet wire. The
+    puller's crc check must reject the blob and fall back to plain
+    re-prefill; the chaos matrix (tools/chaos_sweep.py kvxfer:corrupt)
+    asserts exactly that. Same budget contract as every other knob:
+    deterministic count, armed by env, reset()/reload() re-reads."""
+    left = _budget.get("kvxfer_corrupt")
+    if left is None:
+        left = _budget["kvxfer_corrupt"] = \
+            _int_env("PTPU_CHAOS_KVXFER_CORRUPT")
+    if left <= 0 or not data:
+        return data
+    _budget["kvxfer_corrupt"] = left - 1
+    resilience_event("chaos_inject", site="kvxfer_corrupt",
+                     remaining=left - 1, nbytes=len(data))
+    mid = len(data) // 2
+    return (data[:mid] + bytes(b ^ 0xFF for b in data[mid:mid + 8])
+            + data[mid + 8:])
 
 
 # -- wire-level chaos: in-process TCP fault proxy ---------------------------

@@ -17,6 +17,12 @@ One process, one port, two planes:
   engine frees every candidate's KV blocks (shared prefix blocks drop
   one refcount each) and the loss shows up as
   `requests{reason="cancelled"}`.
+  `POST /v1/tokenize` maps a raw string to the ids the completions
+  route would prefill (serve/tokenizer.py) — `"prompt"` accepts either
+  form. `GET /kvblocks/<digest>` serves this replica's host-tier
+  entries to peers, and the router's `x-ptpu-kv-source` hint makes a
+  request PULL its warm prefix from the advertising peer before it is
+  enqueued (serve/kvxfer.py — disaggregated prefill/decode serving).
 - CONTROL PLANE — the same telemetry the engine records is what
   admits, sheds, and drains: `/metrics` (Prometheus scrape),
   `/healthz` (pure liveness), `/readyz` (503 until the one compiled
@@ -84,7 +90,10 @@ from paddle_tpu.obs.http import json_route, obs_response
 from paddle_tpu.obs.slo import SLOMonitor
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
 from paddle_tpu.resilience.supervisor import RunSupervisor
+from paddle_tpu.serve.kvxfer import KVXferMetrics, encode_tier_blob, \
+    pull_prefix
 from paddle_tpu.serve.sse import DONE_SENTINEL, sse_event
+from paddle_tpu.serve.tokenizer import ByteTokenizer
 from paddle_tpu.utils.log import serve_event
 
 _DIR_INTERVAL_S = 0.25   # default /kvprefixes + /debug refresh cadence
@@ -129,7 +138,9 @@ class ServeFrontend:
                  enable_chaos: bool = False,
                  router_url: Optional[str] = None,
                  register_interval_s: float = 2.0,
-                 tier_spill_interval_s: float = 0.0):
+                 tier_spill_interval_s: float = 0.0,
+                 phase: str = "mixed",
+                 tokenizer_seed: int = 0):
         self.engine = engine
         self.host = host
         self.port = port
@@ -151,6 +162,21 @@ class ServeFrontend:
         # (new process, same port) re-admits itself within one beat.
         self.router_url = router_url.rstrip("/") if router_url else None
         self.register_interval_s = register_interval_s
+        # disaggregated serving (serve/kvxfer.py): the phase rides the
+        # registration heartbeat and the /kvprefixes advertisement so
+        # the router can specialize routing (prefill-heavy traffic to
+        # prefill replicas, the decode continuation to decode ones)
+        if phase not in ("prefill", "decode", "mixed"):
+            raise ValueError(f"phase {phase!r}: want prefill|decode|mixed")
+        self.phase = phase
+        self._kvx = KVXferMetrics(engine.obs)
+        # byte-level front door: string prompts + /v1/tokenize. Needs
+        # vocab >= 16; a tiny test vocab just disables string prompts.
+        try:
+            self.tokenizer: Optional[ByteTokenizer] = ByteTokenizer(
+                engine.model.vocab, seed=tokenizer_seed)
+        except ValueError:
+            self.tokenizer = None
         # warm restarts: > 0 spills the host KV tier to the engine's
         # tier_spill_dir every interval ON TOP of the drain-time spill,
         # so even a SIGKILLed replica warm-starts from a recent
@@ -293,7 +319,8 @@ class ServeFrontend:
             try:
                 conn.request(
                     "POST", "/register",
-                    body=json.dumps({"url": self.url}).encode(),
+                    body=json.dumps({"url": self.url,
+                                     "phase": self.phase}).encode(),
                     headers={"Content-Type": "application/json"})
                 resp = conn.getresponse()
                 resp.read()
@@ -641,9 +668,12 @@ class ServeFrontend:
 
     def _directory_payload(self) -> dict:
         """The /kvprefixes body: this replica's warm-prefix
-        advertisement for the router's fleet prefix directory."""
+        advertisement for the router's fleet prefix directory, plus its
+        serving phase (argv-seeded replicas never POST /register, so
+        the phase has to ride the scrape)."""
         with self._lock:
-            return {"prefixes": list(self._directory)}
+            return {"prefixes": list(self._directory),
+                    "phase": self.phase}
 
     def _debug_payload(self) -> dict:
         """The /debug body: the engine-loop-refreshed scheduler/KV
@@ -660,6 +690,21 @@ class ServeFrontend:
                 "watchdog_s": (self._sup.watchdog_timeout_s
                                if self._sup is not None else 0.0),
             }
+
+    def _kvblocks_route(self, path: str):
+        """GET /kvblocks/<digest> -> one host-tier entry in the kvxfer
+        wire envelope (serve/kvxfer.py), or 404 when this replica does
+        not hold it. Served straight off the handler thread: the tier
+        is thread-safe and the engine loop is never involved, so a
+        peer's pull can never stall this replica's own decoding."""
+        digest = path[len("/kvblocks/"):].strip("/")
+        tier = self.engine.host_tier
+        blob = (encode_tier_blob(tier, digest)
+                if tier is not None and digest else None)
+        if blob is None:
+            return (404, "application/json",
+                    b'{"error": "unknown kv block"}\n')
+        return 200, "application/octet-stream", blob
 
     def _trace_route(self, path: str):
         """GET /trace/<id> -> this replica's span fragment for one
@@ -702,7 +747,8 @@ class ServeFrontend:
                     "/debug/flightrec": json_route(
                         self.flightrec.debug_payload)},
             prefix_routes={"/trace/": self._trace_route,
-                           "/debug/stall": self._stall_route})
+                           "/debug/stall": self._stall_route,
+                           "/kvblocks/": self._kvblocks_route})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
         self._send(h, *resp)
@@ -749,9 +795,16 @@ class ServeFrontend:
             length = int(h.headers.get("Content-Length", "0"))
             body = json.loads(h.rfile.read(length) or b"{}")
             prompt = body["prompt"]
-            if (not isinstance(prompt, list)
+            if isinstance(prompt, str):
+                if self.tokenizer is None:
+                    raise ValueError(
+                        "string prompts need the byte tokenizer "
+                        "(model vocab < 16)")
+                prompt = self.tokenizer.encode(prompt)
+            elif (not isinstance(prompt, list)
                     or not all(isinstance(t, int) for t in prompt)):
-                raise ValueError("prompt must be a list of token ids")
+                raise ValueError(
+                    "prompt must be a list of token ids or a string")
             n = int(body.get("n", 1))
             best_of = int(body.get("best_of", n))
             if n < 1:
@@ -784,8 +837,58 @@ class ServeFrontend:
                        json.dumps({"error": str(e)}).encode() + b"\n")
             return None
 
+    def _handle_tokenize(self, h: BaseHTTPRequestHandler) -> None:
+        """POST /v1/tokenize: {"text": "..."} (or "prompt") -> the
+        token ids /v1/completions would prefill for that string.
+        Engine-free — the mapping is pure (vocab, seed)."""
+        try:
+            length = int(h.headers.get("Content-Length", "0"))
+            body = json.loads(h.rfile.read(length) or b"{}")
+            text = body.get("text", body.get("prompt"))
+            if not isinstance(text, str):
+                raise ValueError('want {"text": "<string>"}')
+            if self.tokenizer is None:
+                raise ValueError(
+                    "no tokenizer: model vocab < 16")
+            tokens = self.tokenizer.encode(text)
+        except (ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send(h, 400, "application/json",
+                       json.dumps({"error": str(e)}).encode() + b"\n")
+            return
+        payload = {"tokens": tokens, "count": len(tokens),
+                   "vocab": self.tokenizer.vocab,
+                   "seed": self.tokenizer.seed}
+        self._send(h, 200, "application/json",
+                   json.dumps(payload).encode() + b"\n")
+
+    def _maybe_pull_kv(self, h: BaseHTTPRequestHandler,
+                       prompt: List[int]) -> None:
+        """Honor the router's transfer hint (x-ptpu-kv-source): pull
+        the warm prefix from the named peer into OUR host tier before
+        the request is enqueued, so admission's revival walk finds the
+        blocks as if they were local. Runs on the handler thread; a
+        failed pull just means the request re-prefills."""
+        source = h.headers.get("x-ptpu-kv-source")
+        tier = self.engine.host_tier
+        if not source or tier is None or source.rstrip("/") == self.url:
+            return
+        max_len = None
+        raw_len = h.headers.get("x-ptpu-kv-len")
+        if raw_len is not None:
+            try:
+                max_len = int(raw_len)
+            except ValueError:
+                max_len = None
+        pull_prefix(tier, source.rstrip("/"), prompt,
+                    self.engine.cache.block_size, metrics=self._kvx,
+                    max_len=max_len)
+
     def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
-        if h.path.split("?")[0] != "/v1/completions":
+        path = h.path.split("?")[0]
+        if path == "/v1/tokenize":
+            self._handle_tokenize(h)
+            return
+        if path != "/v1/completions":
             self._send(h, 404, "text/plain", b"not found\n")
             return
         params = self._parse_completion(h)
@@ -795,6 +898,7 @@ class ServeFrontend:
         if reason is not None:
             self._shed(h, reason)
             return
+        self._maybe_pull_kv(h, params["prompt"])
         stream = _Stream(params)
         with self._lock:
             self._open_streams += 1

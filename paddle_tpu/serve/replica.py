@@ -74,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "forces N virtual devices before jax initializes; "
                         "PTPU_SERVE_ALLREDUCE=fp|int8 picks the decode "
                         "collective wire format")
+    p.add_argument("--phase", default="mixed",
+                   choices=("prefill", "decode", "mixed"),
+                   help="disaggregated-serving phase advertised to the "
+                        "router (serve/kvxfer.py): a prefill replica "
+                        "demotes every finished request's prefix blocks "
+                        "into the host tier so decode replicas can pull "
+                        "them over GET /kvblocks/<digest>")
     # fleet membership (serve/router.py POST /register)
     p.add_argument("--router-url", default=None,
                    help="router base url: heartbeat POST /register so "
@@ -153,7 +160,8 @@ def build_frontend(a: argparse.Namespace):
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
             kv_tier_int8=a.kv_tier_int8,
-            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size)
+            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size,
+            demote_finished=(a.phase == "prefill"))
     else:
         import jax
         import jax.numpy as jnp
@@ -173,7 +181,8 @@ def build_frontend(a: argparse.Namespace):
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
             kv_tier_int8=a.kv_tier_int8,
-            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size)
+            tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size,
+            demote_finished=(a.phase == "prefill"))
     slo = SLOMonitor(
         registry,
         objectives=default_objectives(
@@ -197,7 +206,8 @@ def build_frontend(a: argparse.Namespace):
         enable_chaos=a.enable_chaos,
         router_url=a.router_url,
         register_interval_s=a.register_interval_s,
-        tier_spill_interval_s=a.tier_spill_interval_s)
+        tier_spill_interval_s=a.tier_spill_interval_s,
+        phase=a.phase, tokenizer_seed=a.init_seed)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

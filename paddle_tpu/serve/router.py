@@ -71,6 +71,20 @@ replica failure is turned back into a successful client request:
   weights, so the replayed frames are identical and the client sees
   one untruncated stream ending in `[DONE]`.
 
+DISAGGREGATED SERVING (serve/kvxfer.py). With `kv_transfer` on, a
+directory hit on a replica OTHER than the routed target no longer
+re-routes the request — the router attaches transfer hints
+(`x-ptpu-kv-source`: the advertising replica's url, `x-ptpu-kv-len`:
+the matched prefix length) and the target PULLS the warm blocks into
+its own host tier before admission. Replicas also advertise a serving
+PHASE (`prefill` | `decode` | `mixed`, via /register and /kvprefixes):
+when the fleet has a ready replica of the wanted phase, requests are
+classified by prompt-vs-decode weight (prompt len >=
+`phase_prefill_ratio` x max_new_tokens -> prefill-heavy) and sharded
+over the matching replicas first — a prefill replica computes and
+demotes the prefix, the decode replica pulls it and streams. A failed
+pull costs nothing here: the target just re-prefills.
+
 The relay is unbuffered per frame, so the `[DONE]` untruncated-stream
 invariant survives the extra hop, and a client disconnect propagates:
 the router's write fails, it drops the replica connection, the
@@ -153,6 +167,9 @@ _TIER_RANK = {"device": 1, "host": 0}
 # breaker state as a gauge level (ptpu_router_breaker_state)
 _BREAKER_LEVEL = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
 
+# advertised serving phase as a gauge level (ptpu_router_replica_phase)
+_PHASE_LEVEL = {"mixed": 0.0, "prefill": 1.0, "decode": 2.0}
+
 _LE_RE = re.compile(r'le="([^"]+)"')
 
 
@@ -189,7 +206,7 @@ class ReplicaState:
     __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
                  "queue_depth", "last_scrape", "prefixes", "fails",
                  "breaker", "open_until", "ttft_p95_ms", "registered",
-                 "scraping")
+                 "scraping", "phase")
 
     def __init__(self, url: str):
         parts = urlsplit(url)
@@ -211,6 +228,9 @@ class ReplicaState:
         self.ttft_p95_ms = 0.0
         self.registered = False     # joined via POST /register
         self.scraping = False       # a scrape thread is on it right now
+        # disaggregated serving phase (prefill|decode|mixed): from the
+        # /register heartbeat or the /kvprefixes advertisement
+        self.phase = "mixed"
 
 
 class _RelayState:
@@ -248,7 +268,9 @@ class Router:
                  enable_hedge: bool = True,
                  hedge_ttft_mult: float = 3.0,
                  hedge_min_s: float = 0.05,
-                 hedge_max_s: float = 2.0):
+                 hedge_max_s: float = 2.0,
+                 kv_transfer: bool = False,
+                 phase_prefill_ratio: float = 2.0):
         self.replicas = [ReplicaState(u) for u in replica_urls]
         self.host = host
         self.port = port
@@ -265,6 +287,15 @@ class Router:
         self.hedge_ttft_mult = hedge_ttft_mult
         self.hedge_min_s = hedge_min_s
         self.hedge_max_s = hedge_max_s
+        # kv_transfer flips a directory hit from RE-ROUTING (promote
+        # the advertising replica) to TRANSFER HINTS (keep the routed
+        # target, tell it where to pull the warm blocks from). Opt-in:
+        # re-routing is still the right default for a homogeneous
+        # fleet with no transfer plane.
+        self.kv_transfer = kv_transfer
+        # prompt_len >= ratio * max_new_tokens classifies a request as
+        # prefill-heavy when phase-specialized replicas exist
+        self.phase_prefill_ratio = phase_prefill_ratio
         self.exit_code: Optional[int] = None
 
         self.obs = MetricsRegistry()    # the router's OWN process story
@@ -326,6 +357,18 @@ class Router:
             "ptpu_router_replica_ttft_p95_ms",
             "Replica's scraped TTFT p95 (bucket upper bound) — the "
             "base of the hedge delay", labelnames=("replica",))
+        self._m_kvx_hints = self.obs.counter(
+            "ptpu_router_kvxfer_hints_total",
+            "Requests served with a KV transfer hint attached (the "
+            "target was told to pull the warm prefix from a peer)")
+        self._m_phase_routed = self.obs.counter(
+            "ptpu_router_phase_routed_total",
+            "Requests sharded over phase-matching replicas",
+            labelnames=("phase",))      # phase=prefill|decode
+        self._m_replica_phase = self.obs.gauge(
+            "ptpu_router_replica_phase",
+            "Replica's advertised serving phase: 0 mixed, 1 prefill, "
+            "2 decode", labelnames=("replica",))
 
         # router-side spans under the fleet trace id: one synthetic
         # request id per proxied POST, stitched with the replica's
@@ -372,12 +415,14 @@ class Router:
             serve_event("router_evict", replica=r.url, fails=fails,
                         reason=reason)
 
-    def register_replica(self, url: str) -> ReplicaState:
+    def register_replica(self, url: str,
+                         phase: Optional[str] = None) -> ReplicaState:
         """Admit (or re-admit) a replica by base url: the programmatic
         half of POST /register. New url -> appended to the table and
         probed; evicted url -> breaker forced half-open and probed NOW,
         so a restarted replica is routable without waiting out
-        `breaker_open_s`."""
+        `breaker_open_s`. `phase` (when the heartbeat carries one)
+        updates the replica's advertised serving phase."""
         url = url.rstrip("/")
         with self._lock:
             r = next((x for x in self.replicas if x.url == url), None)
@@ -389,10 +434,15 @@ class Router:
             elif r.breaker == "open":
                 r.breaker = "half_open"
                 r.open_until = 0.0
+            if phase in _PHASE_LEVEL:
+                r.phase = phase
             ready = r.ready
+            phase_pub = r.phase
+        self._m_replica_phase.labels(replica=r.url).set(
+            _PHASE_LEVEL[phase_pub])
         if is_new:
             self._m_membership.labels(event="register").inc()
-            serve_event("router_register", replica=url,
+            serve_event("router_register", replica=url, phase=phase_pub,
                         replicas=len(self.replicas))
         if not ready:
             # probe on the caller's thread (never under the lock): a
@@ -405,15 +455,16 @@ class Router:
             length = int(h.headers.get("Content-Length", "0"))
             body = json.loads(h.rfile.read(length) or b"{}")
             url = str(body.get("url") or "")
+            phase = body.get("phase")
         except (ValueError, json.JSONDecodeError):
-            url = ""
+            url, phase = "", None
         if not url.startswith("http"):
             payload = json.dumps({"ok": False,
                                   "error": "body must be {'url': "
                                            "'http://host:port'}"})
             self._send_json(h, 400, payload)
             return
-        r = self.register_replica(url)
+        r = self.register_replica(url, phase=phase)
         with self._lock:
             known = len(self.replicas)
             ready = r.ready
@@ -442,6 +493,7 @@ class Router:
         reason = ""
         vals = {}
         prefixes: Dict[Tuple[int, str], str] = {}
+        phase: Optional[str] = None
         try:
             conn = HTTPConnection(r.host, r.port,
                                   timeout=self.scrape_timeout_s)
@@ -462,7 +514,12 @@ class Router:
                 pbody = presp.read()
                 if presp.status == 200:
                     try:
-                        for row in json.loads(pbody).get("prefixes", []):
+                        payload = json.loads(pbody)
+                        # phase rides the same advertisement: argv-
+                        # seeded replicas never POST /register
+                        if payload.get("phase") in _PHASE_LEVEL:
+                            phase = payload["phase"]
+                        for row in payload.get("prefixes", []):
                             prefixes[(int(row["len"]),
                                       str(row["digest"]))] = \
                                 str(row.get("tier", "device"))
@@ -487,6 +544,9 @@ class Router:
             r.ready = ready
             r.reason = reason
             r.prefixes = prefixes
+            if phase is not None:
+                r.phase = phase
+            phase_pub = r.phase
             if vals:
                 r.hit_rate = vals.get("ptpu_kv_hit_rate", 0.0)
                 r.queue_depth = vals.get("ptpu_sched_queue_depth", 0.0)
@@ -505,6 +565,8 @@ class Router:
         self._m_replica_prefixes.labels(replica=r.url).set(
             float(len(prefixes)))
         self._m_replica_ttft.labels(replica=r.url).set(ttft_pub)
+        self._m_replica_phase.labels(replica=r.url).set(
+            _PHASE_LEVEL[phase_pub])
         # staleness: keeps GROWING while scrapes fail, so alerting can
         # tell "replica down" from "replica briefly slow"
         age = (time.monotonic() - last_scrape) if last_scrape else -1.0
@@ -567,16 +629,17 @@ class Router:
             self.scrape_now(wait_s=0.0)
 
     # -- routing policy ---------------------------------------------------
-    def _directory_best(self, prompt: Sequence[int],
-                        snapshot: dict) -> Optional[ReplicaState]:
+    def _directory_best(self, prompt: Sequence[int], snapshot: dict
+                        ) -> Tuple[Optional[ReplicaState], int]:
         """The ready replica advertising the LONGEST prefix of `prompt`
-        at the HOTTEST tier, or None when the fleet directory has no
-        match. Digests are memoized per length: one crc32 per distinct
-        advertised prefix length, not per (replica, row)."""
+        at the HOTTEST tier plus that matched length, or (None, 0) when
+        the fleet directory has no match. Digests are memoized per
+        length: one crc32 per distinct advertised prefix length, not
+        per (replica, row)."""
         best: Optional[ReplicaState] = None
         best_score = (-1, -1)
         memo: Dict[int, str] = {}
-        for r, (ready, _, _, prefixes, _) in snapshot.items():
+        for r, (ready, _, _, prefixes, _, _) in snapshot.items():
             if not ready:
                 continue
             for (ln, dg), tier in prefixes.items():
@@ -587,41 +650,76 @@ class Router:
                     memo[ln] = prefix_digest(prompt[:ln])
                 if memo[ln] == dg:
                     best, best_score = r, score
-        return best
+        return best, max(0, best_score[0])
 
-    def _plan(self, prompt: Sequence[int]
+    def _classify_phase(self, prompt: Sequence[int],
+                        max_new_tokens: Optional[int]) -> str:
+        """Which phase specialization serves this request best:
+        "prefill" when the prompt dominates the work (prompt len >=
+        phase_prefill_ratio x expected decode tokens), else "decode"."""
+        max_new = max(1, int(max_new_tokens)
+                      if max_new_tokens is not None else 64)
+        if len(prompt) >= self.phase_prefill_ratio * max_new:
+            return "prefill"
+        return "decode"
+
+    def _plan(self, prompt: Sequence[int],
+              max_new_tokens: Optional[int] = None
               ) -> Tuple[List[ReplicaState], Optional[ReplicaState],
-                         Optional[ReplicaState]]:
-        """(candidates in try-order, directory pick or None, sticky).
-        The hash primary maps over the READY set (in table order), so a
-        dead replica's shard re-maps over survivors; `sticky` is the
-        hash over the FULL member table — the label reference point, so
-        stickiness verdicts don't shift when readiness flaps. Ready
-        fallbacks rank best-first (highest scraped hit rate, shortest
-        queue); routable-but-not-ready replicas trail as a last ditch
-        (the scrape may be stale); breaker-open replicas are not tried
-        at all. When the fleet prefix directory knows a ready replica
-        holding a warm prefix of this prompt, that replica is promoted
-        to the front — warm KV beats where the hash says the prefix
-        should live."""
+                         Optional[ReplicaState], int, Optional[str]]:
+        """(candidates in try-order, directory pick or None, sticky,
+        matched directory prefix length, phase specialization applied
+        or None). The hash primary maps over the READY set (in table
+        order), so a dead replica's shard re-maps over survivors;
+        `sticky` is the hash over the FULL member table — the label
+        reference point, so stickiness verdicts don't shift when
+        readiness flaps. Ready fallbacks rank best-first (highest
+        scraped hit rate, shortest queue); routable-but-not-ready
+        replicas trail as a last ditch (the scrape may be stale);
+        breaker-open replicas are not tried at all.
+
+        PHASE. When the fleet has a ready replica whose advertised
+        phase exactly matches the request's classification, the hash
+        shards over the MATCHING set first and the rest of the ready
+        fleet trails — a mixed fleet (no specialists) routes exactly as
+        before.
+
+        DIRECTORY. When the fleet prefix directory knows a ready
+        replica holding a warm prefix of this prompt: without
+        kv_transfer that replica is promoted to the front (warm KV
+        beats where the hash says the prefix should live); with
+        kv_transfer the ORDER STANDS and the caller attaches transfer
+        hints instead — the routed target pulls the blocks from
+        dir_pick (serve/kvxfer.py)."""
         with self._lock:    # one consistent snapshot to rank against
             stats = {r: (r.ready, r.hit_rate, r.queue_depth,
-                         dict(r.prefixes), r.breaker)
+                         dict(r.prefixes), r.breaker, r.phase)
                      for r in self.replicas}
         members = list(stats.keys())
         if not members:
-            return [], None, None
+            return [], None, None, 0, None
         sticky = members[prefix_shard(prompt, len(members),
                                       self.prefix_len)]
         routable = [r for r in members if stats[r][4] != "open"]
         ready = [r for r in routable if stats[r][0]]
+        want: Optional[str] = None
         if ready:
-            primary = ready[prefix_shard(prompt, len(ready),
-                                         self.prefix_len)]
+            pool = ready
+            wanted = self._classify_phase(prompt, max_new_tokens)
+            matching = [r for r in ready if stats[r][5] == wanted]
+            if matching and len(matching) < len(ready):
+                # phase specialists exist: shard over them first
+                pool = matching
+                want = wanted
+            primary = pool[prefix_shard(prompt, len(pool),
+                                        self.prefix_len)]
             fallbacks = sorted(
-                (r for r in ready if r is not primary),
+                (r for r in pool if r is not primary),
                 key=lambda r: (-stats[r][1], stats[r][2]))
             order = [primary] + fallbacks
+            order += sorted(
+                (r for r in ready if r not in pool),
+                key=lambda r: (-stats[r][1], stats[r][2]))
             in_order = set(map(id, order))
             order += [r for r in routable if id(r) not in in_order]
         else:
@@ -629,13 +727,14 @@ class Router:
             # stale) — but NEVER a breaker-open replica; a fully open
             # fleet sheds until a half-open probe rejoins someone
             order = routable
-        dir_pick = (self._directory_best(prompt, stats)
-                    if self.enable_directory else None)
-        if dir_pick is not None and dir_pick is not order[0]:
+        dir_pick, dir_len = ((self._directory_best(prompt, stats))
+                             if self.enable_directory else (None, 0))
+        if (dir_pick is not None and not self.kv_transfer
+                and dir_pick is not order[0]):
             if dir_pick in order:
                 order.remove(dir_pick)
             order.insert(0, dir_pick)
-        return order, dir_pick, sticky
+        return order, dir_pick, sticky, dir_len, want
 
     def plan_route(self, prompt: Sequence[int]) -> List[ReplicaState]:
         """Candidate replicas in try-order (see _plan)."""
@@ -812,6 +911,7 @@ class Router:
                 "fails": r.fails,
                 "registered": r.registered,
                 "ttft_p95_ms": r.ttft_p95_ms,
+                "phase": r.phase,
             } for r in self.replicas]
             inflight = self._inflight
             draining = self._draining
@@ -820,7 +920,8 @@ class Router:
                 "scrape_interval_s": self.scrape_interval_s,
                 "directory_enabled": self.enable_directory,
                 "retry_budget_tokens": self.retry_budget.tokens(),
-                "hedge_enabled": self.enable_hedge}
+                "hedge_enabled": self.enable_hedge,
+                "kv_transfer": self.kv_transfer}
 
     def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
         resp = obs_response(
@@ -865,11 +966,22 @@ class Router:
         if self._draining:
             self._shed(h, "draining")
             return
+        max_new: Optional[int] = None
         try:
             length = int(h.headers.get("Content-Length", "0"))
             raw = h.rfile.read(length)
-            prompt = json.loads(raw or b"{}").get("prompt") or []
-        except (ValueError, json.JSONDecodeError):
+            body = json.loads(raw or b"{}")
+            prompt = body.get("prompt") or []
+            if isinstance(prompt, str):
+                # string prompts tokenize REPLICA-side; route on the
+                # utf-8 bytes — stable across processes, and identical
+                # strings still shard sticky (the directory simply
+                # won't match until token-level requests warmed it)
+                prompt = list(prompt.encode("utf-8"))
+            mn = body.get("max_new_tokens")
+            if mn is not None:
+                max_new = int(mn)
+        except (ValueError, TypeError, json.JSONDecodeError):
             raw, prompt = b"{}", []
         # fleet trace id: honor the client's, else mint one; the same
         # id tags the router's route/relay spans AND rides the replica
@@ -878,15 +990,18 @@ class Router:
         rid = next(self._trace_seq)
         self.tracer.set_trace_id(rid, tid)
         self.tracer.span_begin(rid, "route")
-        candidates, dir_pick, sticky = self._plan(prompt)
+        candidates, dir_pick, sticky, dir_len, want = self._plan(
+            prompt, max_new)
         if not candidates:
             self.tracer.on_finish(rid, "shed")
             self._shed(h, "no_replica")
             return
+        if want is not None:
+            self._m_phase_routed.labels(phase=want).inc()
         self._track_inflight(+1)
         try:
             self._proxy(h, raw, prompt, candidates, dir_pick, sticky,
-                        tid=tid, rid=rid)
+                        dir_len=dir_len, tid=tid, rid=rid)
         finally:
             self._track_inflight(-1)
 
@@ -1079,6 +1194,7 @@ class Router:
                candidates: List[ReplicaState],
                dir_pick: Optional[ReplicaState] = None,
                sticky: Optional[ReplicaState] = None, *,
+               dir_len: int = 0,
                tid: Optional[str] = None,
                rid: Optional[int] = None) -> None:
         """Drive one request to a `[DONE]`-terminated stream across as
@@ -1117,7 +1233,19 @@ class Router:
                                      kind=retry_kind)
             hedge_pool = (pending if attempt == 1 and self.enable_hedge
                           and pending and not state.started else None)
-            res = self._open_stream(r, raw, headers, hedge_pool, rid)
+            # kv_transfer: when the warm prefix lives on a replica we
+            # are NOT about to try, tell this attempt's target where to
+            # pull it from (per-attempt copy: a later attempt may BE
+            # dir_pick and must not be told to pull from itself)
+            hinted = (self.kv_transfer and dir_pick is not None
+                      and dir_len > 0 and r is not dir_pick)
+            attempt_headers = headers
+            if hinted:
+                attempt_headers = dict(headers)
+                attempt_headers["x-ptpu-kv-source"] = dir_pick.url
+                attempt_headers["x-ptpu-kv-len"] = str(dir_len)
+            res = self._open_stream(r, raw, attempt_headers,
+                                    hedge_pool, rid)
             if res[0] == "shed":
                 last_shed = res[1]
                 retry_kind = "shed"
@@ -1144,6 +1272,11 @@ class Router:
                 kind = "fallback"
             if dir_pick is not None and r_used is dir_pick:
                 self._m_dir_hits.inc()
+            if hinted and r_used is not dir_pick:
+                # the served replica was told where to pull warm KV —
+                # the directory paid off WITHOUT re-routing
+                self._m_dir_hits.inc()
+                self._m_kvx_hints.inc()
             self._m_routed.labels(replica=r_used.url, kind=kind).inc()
             if rid is not None:
                 self.tracer.mark(rid, "routed", replica=r_used.url,
@@ -1243,6 +1376,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "TTFT p95")
     p.add_argument("--hedge-min-s", type=float, default=0.05)
     p.add_argument("--hedge-max-s", type=float, default=2.0)
+    p.add_argument("--kv-transfer", action="store_true",
+                   help="attach KV transfer hints on directory hits "
+                        "instead of re-routing (disaggregated serving)")
+    p.add_argument("--phase-prefill-ratio", type=float, default=2.0,
+                   help="prompt len >= ratio * max_new_tokens routes "
+                        "to prefill-phase replicas when any exist")
     a = p.parse_args(argv)
     router = Router(a.replica, host=a.host, port=a.port,
                     prefix_len=a.prefix_len,
@@ -1257,7 +1396,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     enable_hedge=not a.no_hedge,
                     hedge_ttft_mult=a.hedge_ttft_mult,
                     hedge_min_s=a.hedge_min_s,
-                    hedge_max_s=a.hedge_max_s)
+                    hedge_max_s=a.hedge_max_s,
+                    kv_transfer=a.kv_transfer,
+                    phase_prefill_ratio=a.phase_prefill_ratio)
     router.start().install_signals()
     code = router.wait()
     router.stop()

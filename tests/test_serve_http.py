@@ -117,7 +117,7 @@ class TestStreaming:
         assert body["reason"] == "length"
 
     def test_bad_request_400(self, shared_fe):
-        out = collect_stream(shared_fe.url, {"prompt": "not token ids"})
+        out = collect_stream(shared_fe.url, {"prompt": [1, "two", 3]})
         assert out["status"] == 400
         out = collect_stream(shared_fe.url, {})     # missing prompt
         assert out["status"] == 400

@@ -18,10 +18,19 @@ fault), retry_ratio (token-budget capped), and evicted/rejoined
 membership events (the sustained black-hole must trip the breaker and,
 after heal, rejoin through the half-open probe).
 
+The KVXFER cells break the fleet KV block transfer itself
+(serve/kvxfer.py): a prefill replica behind the proxy feeds a decode
+replica through a kv_transfer router, and each cell faults the
+/kvblocks pull a different way — blob bit-rot (crc-rejected), connect
+refusal, swallowed socket. Acceptance: the pull falls back to plain
+re-prefill (a counted fallback) and the client stream is byte-identical
+to the warm source's own, zero failed / zero truncated.
+
 One JSON line per cell on stdout:
 
     {"cell": "sigterm@4", "mode": "cluster", "ok": true, ...}
     {"cell": "fleet:blackhole", "mode": "fleet", "ok": true, ...}
+    {"cell": "kvxfer:corrupt", "mode": "kvxfer", "ok": true, ...}
 
 Exit code: 0 iff every cell is ok. The fast in-process subset of this
 grid runs in tier-1 as tests/test_chaos.py (`chaos` marker); the fleet
@@ -393,6 +402,132 @@ def run_fleet_grid():
     return oks
 
 
+def run_kvxfer_grid():
+    """The fleet KV-transfer fault matrix (serve/kvxfer.py): a prefill
+    replica behind a NetChaosProxy feeding a decode replica through a
+    kv_transfer router. Cells kvxfer:{corrupt,refuse,blackhole} each
+    break the /kvblocks pull a different way — bit-rot on the blob
+    (crc must reject it), connect refusal, and a swallowed socket
+    (client-side timeout). The acceptance property is the tentpole's
+    NEVER-A-WRONG-ANSWER: every cell must count a fallback and
+    re-prefill to a stream byte-identical to the warm source's own,
+    with zero failed and zero truncated client streams."""
+    import time
+
+    from serve_bench import _spawn_replica, _terminate
+    from paddle_tpu.engine.kvtier import prefix_digest
+    from paddle_tpu.resilience.chaos import NetChaosProxy
+    from paddle_tpu.serve.router import Router
+    from paddle_tpu.serve.sse import collect_stream
+
+    proc_a, base_a = _spawn_replica(extra=(
+        "--phase", "prefill", "--host-tier-bytes", str(1 << 20)))
+    # the decode replica is born with a 1-blob corruption budget
+    # (PTPU_CHAOS_KVXFER_CORRUPT counts down per process): the FIRST
+    # cell's pull eats it, the later wire-fault cells pull clean
+    proc_b, base_b = _spawn_replica(
+        extra=("--phase", "decode", "--host-tier-bytes", str(1 << 20)),
+        env_extra={"PTPU_CHAOS_KVXFER_CORRUPT": "1"})
+    proxy = NetChaosProxy(upstream_port=int(base_a.rsplit(":", 1)[1]))
+    proxy.start()
+    proxy_url = f"http://127.0.0.1:{proxy.port}"
+    # manual scrape_now() only (interval parked at 30s): an armed wire
+    # fault must not let a background scrape breaker-evict the prefill
+    # member — the plan has to keep seeing it to attach the hint. The
+    # router's stream-open patience must exceed the pull deadline
+    # (kvxfer.DEFAULT_TIMEOUT_S = 5s): a black-holed transfer delays
+    # TTFT by one timeout, it must not kill the stream.
+    router = Router([proxy_url, base_b], prefix_len=8,
+                    scrape_interval_s=30.0, scrape_timeout_s=0.5,
+                    connect_timeout_s=8.0, kv_transfer=True).start()
+
+    def scrape_until(pred, timeout_s=20.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            router.scrape_now()
+            if pred():
+                return True
+            time.sleep(0.1)
+        return False
+
+    def specialized():
+        ms = [_fleet_member(router, u) for u in (proxy_url, base_b)]
+        return (all(m is not None and m.ready for m in ms)
+                and ms[0].phase == "prefill" and ms[1].phase == "decode")
+
+    def advertised(prompt):
+        m = _fleet_member(router, proxy_url)
+        return m is not None and any(
+            d == prefix_digest(tuple(prompt[:n]))
+            for (n, d) in m.prefixes if n <= len(prompt))
+
+    def b_fallbacks():
+        from serve_bench import _scrape
+        return _scrape(base_b).get("ptpu_kvxfer_fallbacks_total", 0.0)
+
+    grid = ["corrupt", "refuse", "blackhole"]
+    oks = []
+    try:
+        ready = scrape_until(specialized)
+        for idx, fault in enumerate(grid):
+            name = f"kvxfer:{fault}"
+            if not ready:
+                print(json.dumps({"cell": name, "mode": "kvxfer",
+                                  "ok": False,
+                                  "error": "fleet never specialized"}))
+                oks.append(False)
+                continue
+            # a FRESH prefix per cell: warm it onto the prefill
+            # replica (prefill-classified), wait for the directory
+            # advert, snapshot the local-warm baseline
+            prompt = [(idx * 13 + j * 5 + 3) % 53
+                      for j in range(12)] + [41, 42, 43, 44 + idx]
+            warm = collect_stream(router.url,
+                                  {"prompt": prompt,
+                                   "max_new_tokens": 2}, timeout=60)
+            adv = scrape_until(lambda: advertised(prompt))
+            want = collect_stream(base_a, {"prompt": prompt,
+                                           "max_new_tokens": 16},
+                                  timeout=60)
+            before = b_fallbacks()
+            if fault != "corrupt":      # corrupt is armed in B's env
+                proxy.arm(fault)
+            try:
+                got = collect_stream(router.url,
+                                     {"prompt": prompt,
+                                      "max_new_tokens": 16},
+                                     timeout=60)
+            finally:
+                proxy.heal()
+            fallbacks = b_fallbacks() - before
+            results = [warm, want, got]
+            failed = sum(1 for r in results if r["status"] != 200)
+            truncated = sum(1 for r in results
+                            if r["status"] == 200 and not r["done"])
+            ok = bool(adv and failed == 0 and truncated == 0
+                      and fallbacks >= 1
+                      and got["tokens"] == want["tokens"])
+            print(json.dumps({"cell": name, "mode": "kvxfer", "ok": ok,
+                              "advertised": adv,
+                              "fallbacks": fallbacks,
+                              "failed_requests": failed,
+                              "truncated_streams": truncated,
+                              "tokens_identical":
+                                  got["tokens"] == want["tokens"]}))
+            oks.append(ok)
+    except Exception as e:    # a cell must never take the sweep down
+        print(json.dumps({"cell": "kvxfer_grid", "mode": "kvxfer",
+                          "ok": False,
+                          "error": f"{type(e).__name__}: {e}"}))
+        oks.append(False)
+    finally:
+        router.stop()
+        proxy.stop()
+        _terminate(proc_a)
+        _terminate(proc_b)
+    return oks
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=8)
@@ -413,6 +548,7 @@ def main():
     oks += run_inprocess_grid(tmp, args.steps)
     if not args.inprocess_only and not args.no_fleet:
         oks += run_fleet_grid()
+        oks += run_kvxfer_grid()
     ok = all(o for o in oks if o is not None)
     print(json.dumps({"cell": "TOTAL", "ok": bool(ok),
                       "cells": len(oks), "failed": sum(o is False for o in oks)}))

@@ -891,13 +891,14 @@ _REPLICA_VOCAB = 61
 _LE_RE = re.compile(r'le="([^"]+)"')
 
 
-def _spawn_replica(extra=()):
+def _spawn_replica(extra=(), env_extra=None):
     """Boot `python -m paddle_tpu.serve.replica --port 0` and block
     until its serve_listening line yields the bound port. Returns
     (Popen, base_url); stdout is drained by a daemon thread afterwards
     so serve-event chatter can never fill the pipe and wedge the
-    replica."""
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    replica. `env_extra` adds/overrides environment variables (the
+    chaos sweep uses it to arm in-process fault budgets)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
     proc = subprocess.Popen(
         [sys.executable, "-m", "paddle_tpu.serve.replica",
          "--port", "0", *extra],
@@ -1649,12 +1650,179 @@ def scenario_fleet_chaos(model, variables, args):
     return ok
 
 
+def scenario_disagg(model, variables, args):
+    """Disaggregated serving (ENGINE.md): a prefill replica and a
+    decode replica split by `--phase`, a kv_transfer router between
+    them. Prefill-heavy traffic lands on the prefill replica and its
+    finished blocks demote to the host tier; the decode request is
+    phase-routed to the OTHER replica, which pulls the warm blocks
+    over /kvblocks (through the chaos proxy) and must stream
+    byte-identically to a local-warm baseline — revived, not
+    re-prefilled, compile gauge 1 on both. Then the wire is refused
+    mid-fleet: the pull falls back to plain re-prefill with zero
+    failed and zero truncated streams and the SAME bytes."""
+    del model, variables
+    from paddle_tpu.engine.kvtier import prefix_digest
+    from paddle_tpu.resilience.chaos import NetChaosProxy
+    from paddle_tpu.serve.router import Router
+    from paddle_tpu.serve.sse import collect_stream
+
+    rng = np.random.default_rng(17)
+    tail = rng.integers(0, _REPLICA_VOCAB - 1, 4).tolist()
+    prompts = [rng.integers(0, _REPLICA_VOCAB - 1,
+                            args.router_system_len).tolist() + tail
+               for _ in range(2)]
+    n_decode = 3 * args.router_new_tokens
+
+    # A prefills (demotes on finish), B decodes (pulls). The proxy
+    # fronts A so the router's transfer hints point THROUGH it — the
+    # /kvblocks pull is fault-gateable at the wire.
+    proc_a, base_a = _spawn_replica(extra=(
+        "--phase", "prefill", "--host-tier-bytes", str(1 << 20)))
+    proc_b, base_b = _spawn_replica(extra=(
+        "--phase", "decode", "--host-tier-bytes", str(1 << 20)))
+    proxy = NetChaosProxy(upstream_port=int(base_a.rsplit(":", 1)[1]))
+    proxy.start()
+    proxy.url = f"http://127.0.0.1:{proxy.port}"
+    # scrape interval is parked way out: every pass is a manual
+    # scrape_now(), so arming the proxy can never race a background
+    # scrape into marking the prefill replica unready mid-phase
+    router = Router([proxy.url, base_b],
+                    prefix_len=args.router_system_len,
+                    scrape_interval_s=30.0, scrape_timeout_s=0.5,
+                    connect_timeout_s=2.0, kv_transfer=True).start()
+
+    def advertised(prompt):
+        m = _member(router, proxy.url)
+        return m is not None and any(
+            d == prefix_digest(tuple(prompt[:n]))
+            for (n, d) in m.prefixes if n <= len(prompt))
+
+    def scrape_until(pred, timeout_s=20):
+        def tick():
+            router.scrape_now()
+            return pred()
+        return _wait_for(tick, timeout_s, interval_s=0.1)
+
+    def specialized():
+        ms = [_member(router, u) for u in (proxy.url, base_b)]
+        return (all(m is not None and m.ready for m in ms)
+                and ms[0].phase == "prefill" and ms[1].phase == "decode")
+
+    results = []
+    try:
+        # the fleet must be ready AND phase-scraped before any routed
+        # traffic: classification only shards once specialists exist
+        scrape_until(specialized)
+        phases = {r.url: r.phase for r in router.replicas}
+        # -- warm: prefill-classified, lands on the prefill replica
+        warm = collect_stream(router.url,
+                              {"prompt": prompts[0],
+                               "max_new_tokens": 2}, timeout=60)
+        results.append(warm)
+        adv, adv_s = scrape_until(lambda: advertised(prompts[0]))
+        pre_routed = router.obs.get(
+            "ptpu_router_phase_routed_total").labels(
+                phase="prefill").value
+        emit({"cell": "disagg_warm", "status": warm["status"],
+              "phases": phases, "advertised": bool(adv),
+              "advertise_s": round(adv_s, 3),
+              "prefill_routed": pre_routed})
+        ok_warm = bool(warm["status"] == 200 and warm["done"]
+                       and adv and pre_routed >= 1
+                       and phases.get(proxy.url) == "prefill"
+                       and phases.get(base_b) == "decode")
+
+        # -- pull: baseline direct from warm A, then the decode-routed
+        # request must stream the SAME bytes out of pulled blocks
+        want = collect_stream(base_a, {"prompt": prompts[0],
+                                       "max_new_tokens": n_decode},
+                              timeout=60)
+        got = collect_stream(router.url,
+                             {"prompt": prompts[0],
+                              "max_new_tokens": n_decode}, timeout=60)
+        results += [want, got]
+        scrape_b = _scrape(base_b)
+        pulls = scrape_b.get("ptpu_kvxfer_pulls_total", 0.0)
+        blocks = scrape_b.get("ptpu_kvxfer_blocks_total", 0.0)
+        fallbacks0 = scrape_b.get("ptpu_kvxfer_fallbacks_total", 0.0)
+        revived = scrape_b.get("ptpu_kv_tier_revived_blocks_total", 0.0)
+        hints = router.obs.get("ptpu_router_kvxfer_hints_total").value
+        dir_hits = router.obs.get(
+            "ptpu_router_directory_hits_total").value
+        dec_routed = router.obs.get(
+            "ptpu_router_phase_routed_total").labels(
+                phase="decode").value
+        compiles = {u: _scrape(u).get("ptpu_engine_compiles")
+                    for u in (base_a, base_b)}
+        emit({"cell": "disagg_pull",
+              "tokens_identical": bool(got["tokens"] == want["tokens"]),
+              "pulls": pulls, "blocks": blocks,
+              "bytes": scrape_b.get("ptpu_kvxfer_bytes_total", 0.0),
+              "fallbacks": fallbacks0, "revived_blocks": revived,
+              "kvxfer_hints": hints, "directory_hits": dir_hits,
+              "decode_routed": dec_routed, "compiles": compiles})
+        ok_pull = bool(want["status"] == 200 and got["status"] == 200
+                       and got["done"]
+                       and got["tokens"] == want["tokens"]
+                       and pulls >= 1 and blocks >= 1
+                       and fallbacks0 == 0 and revived > 0
+                       and hints >= 1 and dir_hits >= 1
+                       and dec_routed >= 1
+                       and all(c == 1.0 for c in compiles.values()))
+
+        # -- fault: warm a SECOND prefix on A, then refuse every new
+        # wire connection mid-transfer — the decode replica's pull
+        # must degrade to plain re-prefill with identical bytes
+        warm2 = collect_stream(router.url,
+                               {"prompt": prompts[1],
+                                "max_new_tokens": 2}, timeout=60)
+        results.append(warm2)
+        adv2, _ = scrape_until(lambda: advertised(prompts[1]))
+        want2 = collect_stream(base_a, {"prompt": prompts[1],
+                                        "max_new_tokens": n_decode},
+                               timeout=60)
+        proxy.arm("refuse")
+        got2 = collect_stream(router.url,
+                              {"prompt": prompts[1],
+                               "max_new_tokens": n_decode}, timeout=60)
+        proxy.heal()
+        results += [want2, got2]
+        after_b = _scrape(base_b)
+        fallbacks = after_b.get("ptpu_kvxfer_fallbacks_total", 0.0) \
+            - fallbacks0
+        failed = sum(1 for r in results if r["status"] != 200)
+        truncated = sum(1 for r in results
+                        if r["status"] == 200 and not r["done"])
+        emit({"cell": "disagg_fault", "advertised": bool(adv2),
+              "tokens_identical":
+                  bool(got2["tokens"] == want2["tokens"]),
+              "fallbacks": fallbacks,
+              "failed_requests": failed,
+              "truncated_streams": truncated,
+              "compiles_b": _scrape(base_b).get("ptpu_engine_compiles")})
+        ok_fault = bool(adv2 and got2["status"] == 200 and got2["done"]
+                        and got2["tokens"] == want2["tokens"]
+                        and fallbacks >= 1
+                        and failed == 0 and truncated == 0)
+    finally:
+        router.stop()
+        proxy.stop()
+        for proc in (proc_a, proc_b):
+            _terminate(proc)
+
+    ok = bool(ok_warm and ok_pull and ok_fault)
+    emit({"cell": "disagg_verdict", "ok": ok, "warm_ok": ok_warm,
+          "pull_ok": ok_pull, "fault_ok": ok_fault})
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
                              "mixed", "spec", "nbest", "tiered", "tp",
-                             "router", "fleet_chaos"])
+                             "router", "fleet_chaos", "disagg"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -1709,7 +1877,8 @@ def main():
                  "spec": scenario_spec, "nbest": scenario_nbest,
                  "tiered": scenario_tiered, "tp": scenario_tp,
                  "router": scenario_router,
-                 "fleet_chaos": scenario_fleet_chaos}
+                 "fleet_chaos": scenario_fleet_chaos,
+                 "disagg": scenario_disagg}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
