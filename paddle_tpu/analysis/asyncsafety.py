@@ -1,0 +1,112 @@
+"""Async-safety pass (rule AS001).
+
+AS001 — a blocking call inside an ``async def`` body.  The serve front
+door (serve/aio.py) is ONE event loop carrying every attached client;
+a single blocking call in a coroutine parks all of them at once — the
+failure is invisible at 1 connection and catastrophic at 512 (exactly
+the regime serve_bench's soak cell runs).  Flagged shapes:
+
+* ``time.sleep(...)`` — the loop-wide nap.
+* sync networking: ``socket.*`` module calls, ``http.client.*``, and
+  ``HTTPConnection``/``HTTPSConnection`` construction.  Blocking HTTP
+  belongs on an executor (``loop.run_in_executor``), which passes the
+  callable by reference and so never trips this rule.
+* ``.get()`` with no positional args and no ``timeout=`` — the
+  blocking ``queue.Queue.get`` idiom.  ``get_nowait()`` and awaited
+  ``asyncio.Queue.get()`` are fine (anything under an ``await`` is
+  async composition, not a blocked thread).
+* engine entry points (``step``/``add_request``/``generate``/
+  ``cancel_group``) on an engine-named receiver: these run model steps
+  or host sync on the calling thread; coroutines must hand work to the
+  engine loop via its queues instead.
+
+Nested ``def``s inside a coroutine are NOT scanned under this rule:
+they run wherever they are called (typically an executor thread or the
+engine loop), not on the event loop.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from .core import Finding, SourceFile, dotted_name, expr_text
+
+# module-level call targets that block the calling thread outright
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "socket.socket", "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.socketpair",
+}
+
+# constructing a sync HTTP client inside a coroutine is the same bug:
+# every request on it will block the loop
+_BLOCKING_CTORS = {"HTTPConnection", "HTTPSConnection"}
+
+# ServeEngine entry points that run compiled steps / host sync on the
+# caller's thread (engine/engine.py)
+_ENGINE_METHODS = {"step", "add_request", "generate", "cancel_group"}
+
+
+def run(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.tree is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                _scan_coroutine(sf, node, findings)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _scan_coroutine(sf: SourceFile, fn: ast.AsyncFunctionDef,
+                    findings: List[Finding]) -> None:
+    awaited: Set[int] = set()
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            # nested def: runs where it is CALLED (executor / engine
+            # loop / a fresh task), not inline on this coroutine —
+            # nested async defs get their own scan from run()'s walk
+            return
+        if isinstance(node, ast.Await):
+            # everything under an await is async composition (e.g.
+            # wait_for(q.get(), t) builds a coroutine, blocks nothing)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    awaited.add(id(sub))
+        if isinstance(node, ast.Call) and id(node) not in awaited:
+            label = _blocking_label(node)
+            if label is not None:
+                findings.append(sf.finding(
+                    node.lineno, "AS001",
+                    f"blocking call {label} inside 'async def "
+                    f"{fn.name}' parks the event loop"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+
+
+def _blocking_label(node: ast.Call) -> Optional[str]:
+    name = dotted_name(node.func)
+    if name in _BLOCKING_CALLS or (name or "").startswith("http.client."):
+        return f"{name}(...)"
+    if isinstance(node.func, ast.Name) and node.func.id in _BLOCKING_CTORS:
+        return f"{node.func.id}(...)"
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    attr = node.func.attr
+    recv = expr_text(node.func.value)
+    if attr in _BLOCKING_CTORS:
+        return f"{recv}.{attr}(...)"
+    if attr == "get" and not node.args \
+            and not any(kw.arg == "timeout" for kw in node.keywords):
+        # zero-arg get without a timeout: queue.Queue.get, not
+        # dict.get (which needs the key positionally)
+        return f"{recv}.get()"
+    if attr in _ENGINE_METHODS and "engine" in recv.lower():
+        return f"{recv}.{attr}(...)"
+    return None

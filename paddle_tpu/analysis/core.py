@@ -2,8 +2,8 @@
 
 The analysis suite is pure stdlib (``ast`` + ``tokenize``) so it can run
 in CI containers with nothing installed beyond Python itself.  Passes
-live in sibling modules (trace_purity, locks, telemetry, hygiene); this
-module owns everything they share:
+live in sibling modules (trace_purity, locks, asyncsafety, telemetry,
+hygiene); this module owns everything they share:
 
 * ``SourceFile`` — parsed AST plus a tokenize-derived comment map (a
   regex over raw lines would mis-fire on ``#`` inside string literals),
@@ -46,6 +46,7 @@ RULES: Dict[str, str] = {
     "LK001": "write to a guarded-by attribute outside its lock",
     "LK002": "lock-acquisition-order cycle between classes",
     "LK003": "blocking call while holding a lock",
+    "AS001": "blocking call inside an async def body (parks the event loop)",
     "TS001": "metric series not documented in OBSERVABILITY.md",
     "TS002": "documented metric series never registered in code",
     "TS003": "metric kind/label-set disagrees with OBSERVABILITY.md",
@@ -356,7 +357,7 @@ def run_analysis(
     telemetry pass; defaults to ``<root>/OBSERVABILITY.md`` when present.
     ``rules`` optionally restricts output to a subset of rule ids.
     """
-    from . import hygiene, locks, telemetry, trace_purity
+    from . import asyncsafety, hygiene, locks, telemetry, trace_purity
 
     files = load_files(paths, root)
     findings: List[Finding] = [f.parse_error for f in files if f.parse_error]
@@ -368,6 +369,7 @@ def run_analysis(
 
     findings.extend(trace_purity.run(live))
     findings.extend(locks.run(live))
+    findings.extend(asyncsafety.run(live))
     findings.extend(telemetry.run(live, doc_path, root))
     findings.extend(hygiene.run(live))
 

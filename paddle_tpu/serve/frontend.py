@@ -12,7 +12,7 @@ One process, one port, two planes:
   compete in the mean-logprob ranking the done frame reports.
   Streaming falls out of the engine's iteration-level scheduling: the
   engine thread runs `step()` continuously and per-token callbacks fan
-  tokens out to per-request queues that HTTP handler threads drain. A
+  tokens out to per-request queues that handler COROUTINES drain. A
   client that disconnects mid-stream cancels its whole group — the
   engine frees every candidate's KV blocks (shared prefix blocks drop
   one refcount each) and the loss shows up as
@@ -52,10 +52,25 @@ stuck request.
 
 THREADING. The engine is single-threaded by design (compiled steps,
 host-side allocator bookkeeping). All engine mutation happens on ONE
-loop thread; HTTP handler threads only enqueue work (submissions,
-cancellations) onto thread-safe queues and block on their own token
-queue. The registry and SLO monitor are thread-safe, so scrapes and
-admission checks never touch the engine.
+loop thread. The connection side is an asyncio event loop on ONE
+acceptor thread (serve/aio.py): each connection is a coroutine that
+only enqueues work (submissions, cancellations) onto thread-safe
+queues and parks on its stream's event, woken from the engine thread
+via `loop.call_soon_threadsafe`. Thousands of idle SSE streams cost
+coroutines, not OS threads — `ptpu_serve_conn_threads` stays flat
+while `ptpu_serve_open_connections` climbs. Disconnects come from the
+transport (a parked read resolves on peer close); writes are
+backpressured per-connection with a slow-client eviction deadline
+(`write_deadline_s` → `ptpu_serve_slow_client_evictions_total`), so a
+stalled reader frees its KV instead of wedging the fan-out. The
+registry and SLO monitor are thread-safe, so scrapes and admission
+checks never touch the engine.
+
+FRONT-DOOR SECURITY. `tls_cert`/`tls_key` wrap the listening
+transport in stdlib TLS (the url property flips to https), and
+`auth_token` requires `Authorization: Bearer <token>` on every route
+except `/healthz` (liveness probes stay credential-free) — mismatch
+is a 401 before any routing or admission work happens.
 
 PREEMPTIBILITY. SIGTERM (or `begin_drain()`) flips readiness off,
 sheds new work with reason="draining", lets every in-flight stream run
@@ -69,18 +84,16 @@ to reschedule" from "crashed".
 
 from __future__ import annotations
 
+import asyncio
 import json
 import queue
-import select
 import signal
-import socket
 import threading
 import time
 import uuid
 from collections import deque
 from http.client import HTTPConnection
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 from urllib.parse import urlsplit
 
 from paddle_tpu.engine.engine import ServeEngine
@@ -90,6 +103,8 @@ from paddle_tpu.obs.http import json_route, obs_response
 from paddle_tpu.obs.slo import SLOMonitor
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
 from paddle_tpu.resilience.supervisor import RunSupervisor
+from paddle_tpu.serve.aio import AioConnection, AioRequest, \
+    AsyncHTTPServer, SlowClientError, make_server_tls_context
 from paddle_tpu.serve.kvxfer import KVXferMetrics, encode_tier_blob, \
     pull_prefix
 from paddle_tpu.serve.sse import DONE_SENTINEL, sse_event
@@ -102,12 +117,20 @@ _DIR_INTERVAL_S = 0.25   # default /kvprefixes + /debug refresh cadence
 class _Stream:
     """Plumbing for one in-flight completion GROUP (1 primary +
     n - 1 forked candidates share one HTTP response): the engine
-    thread feeds `q`; the HTTP handler thread drains it. Items:
-    ("token", int, cand_index), ("done", reason, tokens, extra) where
-    extra is None for n == 1 and {"best_index", "candidates"} for a
-    parallel-sampling group, ("error", message)."""
+    thread feeds `q` via `push()`; the handler coroutine drains it.
+    Items: ("token", int, cand_index), ("done", reason, tokens, extra)
+    where extra is None for n == 1 and {"best_index", "candidates"}
+    for a parallel-sampling group, ("error", message).
 
-    __slots__ = ("params", "q", "req", "streamed", "cand_pos")
+    The queue stays a thread-safe `queue.Queue` (warmup drains it
+    BLOCKING before any event loop exists); `attach()` bridges it to
+    the connection coroutine — after that every push also wakes the
+    stream's asyncio.Event via `loop.call_soon_threadsafe`, so a
+    parked consumer resumes without polling. `gone` is flipped in-loop
+    by the transport disconnect watcher."""
+
+    __slots__ = ("params", "q", "req", "streamed", "cand_pos",
+                 "loop", "ev", "gone")
 
     def __init__(self, params: dict):
         self.params = params
@@ -115,6 +138,27 @@ class _Stream:
         self.req: Optional[Request] = None
         self.streamed = 0
         self.cand_pos: Dict[int, int] = {}   # candidate -> tokens sent
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.ev: Optional[asyncio.Event] = None
+        self.gone = False
+
+    def attach(self, loop: asyncio.AbstractEventLoop,
+               ev: asyncio.Event) -> None:
+        """Bind the consumer side; call BEFORE submitting to the
+        engine so no push can miss the wake-up."""
+        self.ev = ev
+        self.loop = loop
+
+    def push(self, item: tuple) -> None:
+        """Engine-thread producer: enqueue + wake the parked
+        coroutine (a no-op wake before attach/after loop teardown)."""
+        self.q.put(item)
+        loop, ev = self.loop, self.ev
+        if loop is not None and ev is not None:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass                    # loop already closed (teardown)
 
 
 class ServeFrontend:
@@ -140,10 +184,31 @@ class ServeFrontend:
                  register_interval_s: float = 2.0,
                  tier_spill_interval_s: float = 0.0,
                  phase: str = "mixed",
-                 tokenizer_seed: int = 0):
+                 tokenizer_seed: int = 0,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 auth_token: Optional[str] = None,
+                 write_deadline_s: float = 30.0,
+                 sock_sndbuf: int = 0,
+                 write_buffer_limit: int = 0):
         self.engine = engine
         self.host = host
         self.port = port
+        # front-door security: TLS on the listening transport + bearer
+        # auth (everything except /healthz) — both optional, both
+        # enforced before any routing happens
+        if bool(tls_cert) != bool(tls_key):
+            raise ValueError("tls_cert and tls_key must be set together")
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
+        self.auth_token = auth_token
+        # slow-client eviction: a stream whose peer can't drain a write
+        # within this deadline is cancelled (KV freed) and its
+        # transport aborted. sock_sndbuf/write_buffer_limit shrink the
+        # server-side buffering so tests can trip it with tiny streams.
+        self.write_deadline_s = write_deadline_s
+        self.sock_sndbuf = sock_sndbuf
+        self.write_buffer_limit = write_buffer_limit
         self.obs = engine.obs
         self.slo = slo if slo is not None else SLOMonitor(engine.obs)
         self.slo_interval_s = slo_interval_s
@@ -187,9 +252,8 @@ class ServeFrontend:
         self._register_thread: Optional[threading.Thread] = None
         self._stop_register = threading.Event()
 
-        self._server: Optional[ThreadingHTTPServer] = None
+        self._server: Optional[AsyncHTTPServer] = None
         self._engine_thread: Optional[threading.Thread] = None
-        self._serve_thread: Optional[threading.Thread] = None
         self._work = threading.Event()       # engine loop wake-up
         self._stopped = threading.Event()    # engine loop exited
         self._submit: "deque[_Stream]" = deque()
@@ -242,6 +306,24 @@ class ServeFrontend:
             "ptpu_serve_ready",
             "1 when /readyz reports ready (warm and not draining)")
         self._m_ready.set(0.0)
+        # the asyncio scaling claim, as a gauge pair: connections climb
+        # with load, OS threads stay flat (engine loop + acceptor +
+        # a constant) — serve_bench's soak cell asserts exactly this
+        self._m_open_conns = m.gauge(
+            "ptpu_serve_open_connections",
+            "Live front-door connections (idle SSE streams park here "
+            "as coroutines, not threads)")
+        self._m_conn_threads = m.gauge(
+            "ptpu_serve_conn_threads",
+            "OS threads in the process at the last connection event "
+            "(flat vs open_connections under the asyncio front door)")
+        self._m_evictions = m.counter(
+            "ptpu_serve_slow_client_evictions_total",
+            "Streams cancelled at the per-connection write deadline "
+            "(stalled readers; their KV blocks are freed)")
+        self._m_token_write = m.histogram(
+            "ptpu_serve_token_write_seconds",
+            "Per-token SSE write+drain latency")
 
     # -- readiness --------------------------------------------------------
     def readiness(self):
@@ -279,26 +361,21 @@ class ServeFrontend:
         self.flightrec.install()
         if self._sup is not None:
             self._sup.start_watchdog()
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            # HTTP/1.0: SSE bodies are close-delimited, no chunking
-            def do_GET(self):                       # noqa: N802
-                outer._handle_get(self)
-
-            def do_POST(self):                      # noqa: N802
-                outer._handle_post(self)
-
-            def log_message(self, *args):
-                pass
-
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._serve_thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="ptpu-serve-http")
-        self._serve_thread.start()
+        tls_ctx = None
+        if self.tls_cert and self.tls_key:
+            tls_ctx = make_server_tls_context(self.tls_cert, self.tls_key)
+        # ONE acceptor thread owns the event loop; every connection is
+        # a coroutine (serve/aio.py) — HTTP/1.0 close-delimited, no
+        # chunking, byte-compatible with the threaded front it replaces
+        self._server = AsyncHTTPServer(
+            self.host, self.port, self._a_dispatch,
+            name="ptpu-serve-http", tls_context=tls_ctx,
+            on_open=self._conn_opened, on_close=self._conn_closed,
+            write_deadline_s=self.write_deadline_s,
+            sock_sndbuf=self.sock_sndbuf,
+            write_buffer_limit=self.write_buffer_limit)
+        self._server.start()
+        self.port = self._server.port
         serve_event("serve_listening", host=self.host, port=self.port,
                     url=self.url)
         if self.router_url:
@@ -342,7 +419,8 @@ class ServeFrontend:
 
     @property
     def url(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls_cert else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def warmup(self) -> None:
         """Run one tiny request through the engine so the single
@@ -421,12 +499,8 @@ class ServeFrontend:
         if self._sup is not None:
             self._sup.stop_watchdog()
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
+            self._server.stop()
             self._server = None
-        if self._serve_thread is not None:
-            self._serve_thread.join(timeout=5)
-            self._serve_thread = None
 
     # -- engine loop ------------------------------------------------------
     def _engine_loop(self) -> None:
@@ -551,7 +625,7 @@ class ServeFrontend:
                 # compete in the best-of ranking, never reach the wire
                 if i >= n_stream:
                     return None
-                return lambda tok, s=s, i=i: s.q.put(("token", tok, i))
+                return lambda tok, s=s, i=i: s.push(("token", tok, i))
 
             try:
                 req = self.engine.add_request(
@@ -561,14 +635,14 @@ class ServeFrontend:
                     deadline_ms=p["deadline_ms"],
                     n=p.get("best_of", 1),
                     fork_callback=_fork_cb,
-                    callback=lambda tok, s=stream: s.q.put(("token", tok, 0)))
+                    callback=lambda tok, s=stream: s.push(("token", tok, 0)))
                 stream.req = req
                 self.engine.tracer.set_trace_id(
                     req.req_id, p.get("trace_id"))
                 with self._lock:
                     self._active[req.req_id] = stream
             except Exception as e:       # bad prompt: surface as 400
-                stream.q.put(("error", str(e)))
+                stream.push(("error", str(e)))
         while self._cancel:
             stream = self._cancel.popleft()
             if stream.req is not None:
@@ -620,17 +694,17 @@ class ServeFrontend:
                 del self._active[rid]
         for rid, s in done:
             if s.req.n_candidates == 1:
-                s.q.put(("done", s.req.finish_reason,
-                         ServeEngine._generated_of(s.req), None))
+                s.push(("done", s.req.finish_reason,
+                        ServeEngine._generated_of(s.req), None))
             else:
                 best_idx, cands = self._rank_group(s.req)
                 best = cands[best_idx]
                 n_stream = s.params.get("n", 1)
-                s.q.put(("done", best["reason"], best["tokens"],
-                         {"best_index": best_idx,
-                          # silent best_of-only candidates stay
-                          # server-side; the wire sees n candidates
-                          "candidates": cands[:n_stream]}))
+                s.push(("done", best["reason"], best["tokens"],
+                        {"best_index": best_idx,
+                         # silent best_of-only candidates stay
+                         # server-side; the wire sees n candidates
+                         "candidates": cands[:n_stream]}))
 
     def _drain_finished(self) -> bool:
         """True once every in-flight stream completed (or the deadline
@@ -664,7 +738,7 @@ class ServeFrontend:
                 self.engine.cancel_group(s.req)
                 if count_drain:
                     self._m_drain_cancelled.inc()
-            s.q.put(("done", "cancelled", [], None))
+            s.push(("done", "cancelled", [], None))
 
     def _directory_payload(self) -> dict:
         """The /kvprefixes body: this replica's warm-prefix
@@ -736,11 +810,38 @@ class ServeFrontend:
         return (200, "application/json",
                 json.dumps({"stall_s": seconds}).encode() + b"\n")
 
-    # -- HTTP handlers ----------------------------------------------------
-    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+    # -- connection events (acceptor-loop thread) -------------------------
+    def _conn_opened(self) -> None:
+        self._m_open_conns.inc()
+        self._m_conn_threads.set(float(threading.active_count()))
+
+    def _conn_closed(self) -> None:
+        self._m_open_conns.dec()
+        self._m_conn_threads.set(float(threading.active_count()))
+
+    # -- HTTP handlers (coroutines on the serve/aio.py loop) --------------
+    async def _a_dispatch(self, req: AioRequest,
+                          conn: AioConnection) -> None:
+        if self.auth_token and req.path.split("?")[0] != "/healthz":
+            # /healthz stays credential-free: a liveness probe must
+            # never fail for a config (secret-rotation) reason
+            if req.header("authorization", "") \
+                    != f"Bearer {self.auth_token}":
+                await conn.send(401, "application/json",
+                                b'{"error": "unauthorized"}\n',
+                                {"WWW-Authenticate": "Bearer"})
+                return
+        if req.method == "GET":
+            await self._a_get(req, conn)
+        elif req.method == "POST":
+            await self._a_post(req, conn)
+        else:
+            await conn.send(405, "text/plain", b"method not allowed\n")
+
+    async def _a_get(self, req: AioRequest, conn: AioConnection) -> None:
         self._set_ready_gauge()     # traffic may have warmed the engine
         resp = obs_response(
-            h.path, self.obs, readiness=self.readiness,
+            req.path, self.obs, readiness=self.readiness,
             routes={"/slo": json_route(self.slo.verdict),
                     "/kvprefixes": json_route(self._directory_payload),
                     "/debug": json_route(self._debug_payload),
@@ -751,30 +852,16 @@ class ServeFrontend:
                            "/kvblocks/": self._kvblocks_route})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
-        self._send(h, *resp)
+        await conn.send(*resp)
 
-    @staticmethod
-    def _send(h: BaseHTTPRequestHandler, status: int, ctype: str,
-              body: bytes, extra_headers: Optional[dict] = None) -> None:
-        try:
-            h.send_response(status)
-            h.send_header("Content-Type", ctype)
-            h.send_header("Content-Length", str(len(body)))
-            for k, v in (extra_headers or {}).items():
-                h.send_header(k, v)
-            h.end_headers()
-            h.wfile.write(body)
-        except (BrokenPipeError, ConnectionResetError):
-            pass
-
-    def _shed(self, h: BaseHTTPRequestHandler, reason: str) -> None:
+    async def _a_shed(self, conn: AioConnection, reason: str) -> None:
         self._m_sheds.labels(reason=reason).inc()
         serve_event("serve_shed", reason=reason,
                     queue_depth=self.engine.scheduler.queue_depth)
         body = json.dumps({"error": "overloaded", "reason": reason,
                            "retry_after_s": 1.0}).encode() + b"\n"
-        self._send(h, 503, "application/json", body,
-                   {"Retry-After": "1"})
+        await conn.send(503, "application/json", body,
+                        {"Retry-After": "1"})
 
     def _admission_shed_reason(self) -> Optional[str]:
         """Why a new request must bounce, or None to admit. Order
@@ -789,11 +876,11 @@ class ServeFrontend:
             return f"slo_{burning[0]}"
         return None
 
-    def _parse_completion(self, h: BaseHTTPRequestHandler
-                          ) -> Optional[dict]:
+    def _parse_completion(self, req: AioRequest
+                          ) -> Tuple[Optional[dict], Optional[bytes]]:
+        """(params, None), or (None, body) for a 400 response."""
         try:
-            length = int(h.headers.get("Content-Length", "0"))
-            body = json.loads(h.rfile.read(length) or b"{}")
+            body = json.loads(req.body or b"{}")
             prompt = body["prompt"]
             if isinstance(prompt, str):
                 if self.tokenizer is None:
@@ -829,21 +916,19 @@ class ServeFrontend:
                 # fleet trace id: the router propagates its minted id
                 # via x-ptpu-trace; a direct client gets one minted
                 # here, so every stream is traceable either way
-                "trace_id": (h.headers.get("x-ptpu-trace")
+                "trace_id": (req.header("x-ptpu-trace")
                              or uuid.uuid4().hex[:16]),
-            }
+            }, None
         except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
-            self._send(h, 400, "application/json",
-                       json.dumps({"error": str(e)}).encode() + b"\n")
-            return None
+            return None, json.dumps({"error": str(e)}).encode() + b"\n"
 
-    def _handle_tokenize(self, h: BaseHTTPRequestHandler) -> None:
+    async def _a_tokenize(self, req: AioRequest,
+                          conn: AioConnection) -> None:
         """POST /v1/tokenize: {"text": "..."} (or "prompt") -> the
         token ids /v1/completions would prefill for that string.
         Engine-free — the mapping is pure (vocab, seed)."""
         try:
-            length = int(h.headers.get("Content-Length", "0"))
-            body = json.loads(h.rfile.read(length) or b"{}")
+            body = json.loads(req.body or b"{}")
             text = body.get("text", body.get("prompt"))
             if not isinstance(text, str):
                 raise ValueError('want {"text": "<string>"}')
@@ -852,28 +937,28 @@ class ServeFrontend:
                     "no tokenizer: model vocab < 16")
             tokens = self.tokenizer.encode(text)
         except (ValueError, TypeError, json.JSONDecodeError) as e:
-            self._send(h, 400, "application/json",
-                       json.dumps({"error": str(e)}).encode() + b"\n")
+            await conn.send(400, "application/json",
+                            json.dumps({"error": str(e)}).encode() + b"\n")
             return
         payload = {"tokens": tokens, "count": len(tokens),
                    "vocab": self.tokenizer.vocab,
                    "seed": self.tokenizer.seed}
-        self._send(h, 200, "application/json",
-                   json.dumps(payload).encode() + b"\n")
+        await conn.send(200, "application/json",
+                        json.dumps(payload).encode() + b"\n")
 
-    def _maybe_pull_kv(self, h: BaseHTTPRequestHandler,
-                       prompt: List[int]) -> None:
+    def _maybe_pull_kv(self, req: AioRequest, prompt: List[int]) -> None:
         """Honor the router's transfer hint (x-ptpu-kv-source): pull
         the warm prefix from the named peer into OUR host tier before
         the request is enqueued, so admission's revival walk finds the
-        blocks as if they were local. Runs on the handler thread; a
-        failed pull just means the request re-prefills."""
-        source = h.headers.get("x-ptpu-kv-source")
+        blocks as if they were local. Blocking HTTP — the async
+        handler runs it in the loop's executor; a failed pull just
+        means the request re-prefills."""
+        source = req.header("x-ptpu-kv-source")
         tier = self.engine.host_tier
         if not source or tier is None or source.rstrip("/") == self.url:
             return
         max_len = None
-        raw_len = h.headers.get("x-ptpu-kv-len")
+        raw_len = req.header("x-ptpu-kv-len")
         if raw_len is not None:
             try:
                 max_len = int(raw_len)
@@ -883,32 +968,38 @@ class ServeFrontend:
                     self.engine.cache.block_size, metrics=self._kvx,
                     max_len=max_len)
 
-    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
-        path = h.path.split("?")[0]
+    async def _a_post(self, req: AioRequest, conn: AioConnection) -> None:
+        path = req.path.split("?")[0]
         if path == "/v1/tokenize":
-            self._handle_tokenize(h)
+            await self._a_tokenize(req, conn)
             return
         if path != "/v1/completions":
-            self._send(h, 404, "text/plain", b"not found\n")
+            await conn.send(404, "text/plain", b"not found\n")
             return
-        params = self._parse_completion(h)
+        params, err = self._parse_completion(req)
         if params is None:
+            await conn.send(400, "application/json", err)
             return
         reason = self._admission_shed_reason()
         if reason is not None:
-            self._shed(h, reason)
+            await self._a_shed(conn, reason)
             return
-        self._maybe_pull_kv(h, params["prompt"])
+        if req.header("x-ptpu-kv-source"):
+            # blocking peer pull: off the loop, into the executor
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._maybe_pull_kv, req, params["prompt"])
         stream = _Stream(params)
+        # bind the wake-up bridge BEFORE the engine can see the stream
+        stream.attach(asyncio.get_running_loop(), asyncio.Event())
         with self._lock:
             self._open_streams += 1
         try:
             self._submit.append(stream)
             self._work.set()
             if params["stream"]:
-                self._stream_response(h, stream)
+                await self._a_stream_response(conn, stream)
             else:
-                self._aggregate_response(h, stream)
+                await self._a_aggregate_response(conn, stream)
         finally:
             with self._lock:
                 self._open_streams -= 1
@@ -921,51 +1012,60 @@ class ServeFrontend:
         return 300.0
 
     @staticmethod
-    def _client_gone(h: BaseHTTPRequestHandler) -> bool:
-        """Peek the client socket for EOF/RST — an SSE client sends
-        nothing after its request, so readability means it hung up.
-        This catches a disconnect even while the stream is between
-        tokens (a write would only fail on the NEXT token)."""
-        try:
-            r, _, _ = select.select([h.connection], [], [], 0)
-            if not r:
-                return False
-            return h.connection.recv(1, socket.MSG_PEEK) == b""
-        except (OSError, ValueError):
-            return True
-
-    def _stream_response(self, h: BaseHTTPRequestHandler,
-                         stream: _Stream) -> None:
-        try:
-            h.send_response(200)
-            h.send_header("Content-Type", "text/event-stream")
-            h.send_header("Cache-Control", "no-cache")
-            h.end_headers()
-        except (BrokenPipeError, ConnectionResetError):
-            self._request_cancel(stream)
-            return
-        deadline = time.monotonic() + self._stream_timeout(stream.params)
+    async def _a_next_item(stream: _Stream,
+                           deadline: float) -> Optional[tuple]:
+        """Next queue item, or None at the absolute loop-time
+        deadline, or ("gone",) when the disconnect watcher fired. The
+        clear-check-wait order makes the wake-up race-free: a push
+        landing between the empty get and the wait re-sets the event
+        AFTER the clear, so the wait returns immediately."""
+        loop = asyncio.get_running_loop()
         while True:
+            stream.ev.clear()
             try:
-                item = stream.q.get(timeout=0.05)
+                return stream.q.get_nowait()
             except queue.Empty:
-                if self._client_gone(h):
-                    self._request_cancel(stream)
-                    return
-                if time.monotonic() > deadline:
-                    self._request_cancel(stream)
-                    return
-                continue
+                pass
+            if stream.gone:
+                return ("gone",)
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return None
             try:
+                await asyncio.wait_for(stream.ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _a_stream_response(self, conn: AioConnection,
+                                 stream: _Stream) -> None:
+        # the transport tells us about a hang-up the moment it
+        # happens — an SSE client sends nothing after its request, so
+        # a completed read (EOF or RST) means it is gone, even while
+        # the stream is parked between tokens
+        def _gone() -> None:
+            stream.gone = True
+            stream.ev.set()
+        conn.watch_disconnect(_gone)
+        deadline = (asyncio.get_running_loop().time()
+                    + self._stream_timeout(stream.params))
+        try:
+            await conn.start_sse()
+            while True:
+                item = await self._a_next_item(stream, deadline)
+                if item is None or item[0] == "gone":
+                    # engine wedged past the deadline, or client left
+                    self._request_cancel(stream)
+                    return
                 if item[0] == "token":
                     _, tok, cand = item
                     pos = stream.cand_pos.get(cand, 0)
                     # `index` tags the CANDIDATE (parallel sampling);
                     # `pos` is the token's position within that
                     # candidate's stream
-                    h.wfile.write(sse_event(
+                    t0 = time.perf_counter()
+                    await conn.write(sse_event(
                         {"token": tok, "index": cand, "pos": pos}))
-                    h.wfile.flush()
+                    self._m_token_write.observe(time.perf_counter() - t0)
                     stream.cand_pos[cand] = pos + 1
                     stream.streamed += 1
                 elif item[0] == "done":
@@ -977,33 +1077,40 @@ class ServeFrontend:
                              "trace_id": stream.params.get("trace_id")}
                     if extra is not None:
                         frame.update(extra)
-                    h.wfile.write(sse_event(frame))
-                    h.wfile.write(sse_event(DONE_SENTINEL))
-                    h.wfile.flush()
+                    await conn.write(sse_event(frame)
+                                     + sse_event(DONE_SENTINEL))
                     return
                 else:                              # ("error", msg)
-                    h.wfile.write(sse_event(
+                    await conn.write(sse_event(
                         {"error": item[1], "done": True,
-                         "reason": "error"}))
-                    h.wfile.write(sse_event(DONE_SENTINEL))
-                    h.wfile.flush()
+                         "reason": "error"}) + sse_event(DONE_SENTINEL))
                     return
-            except (BrokenPipeError, ConnectionResetError, OSError):
-                # client went away mid-stream: free its KV now
-                self._request_cancel(stream)
-                return
+        except SlowClientError:
+            # the peer stopped draining: its transport is already
+            # aborted — evict the stream so its KV frees NOW
+            self._m_evictions.inc()
+            serve_event("serve_slow_client_evicted",
+                        req_id=stream.req.req_id if stream.req else None,
+                        streamed=stream.streamed,
+                        deadline_s=self.write_deadline_s)
+            self._request_cancel(stream)
+        except (ConnectionError, OSError):
+            # client went away mid-stream: free its KV now
+            self._request_cancel(stream)
+        finally:
+            conn.cancel_watch()
 
-    def _aggregate_response(self, h: BaseHTTPRequestHandler,
-                            stream: _Stream) -> None:
+    async def _a_aggregate_response(self, conn: AioConnection,
+                                    stream: _Stream) -> None:
         tokens: List[int] = []
         timeout = self._stream_timeout(stream.params)
+        loop = asyncio.get_running_loop()
         while True:
-            try:
-                item = stream.q.get(timeout=timeout)
-            except queue.Empty:
+            item = await self._a_next_item(stream, loop.time() + timeout)
+            if item is None:
                 self._request_cancel(stream)
-                self._send(h, 504, "application/json",
-                           b'{"error": "timed out"}\n')
+                await conn.send(504, "application/json",
+                                b'{"error": "timed out"}\n')
                 return
             if item[0] == "token":
                 if item[2] == 0:        # aggregate body reports best /
@@ -1018,11 +1125,12 @@ class ServeFrontend:
                 if extra is not None:
                     payload.update(extra)
                 body = json.dumps(payload).encode() + b"\n"
-                self._send(h, 200, "application/json", body)
+                await conn.send(200, "application/json", body)
                 return
-            else:
-                self._send(h, 400, "application/json",
-                           json.dumps({"error": item[1]}).encode() + b"\n")
+            elif item[0] == "error":
+                await conn.send(400, "application/json",
+                                json.dumps({"error": item[1]}).encode()
+                                + b"\n")
                 return
 
     def _request_cancel(self, stream: _Stream) -> None:

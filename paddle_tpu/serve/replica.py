@@ -93,6 +93,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-deadline-s", type=float, default=30.0)
     p.add_argument("--default-max-new-tokens", type=int, default=32)
     p.add_argument("--default-deadline-ms", type=float, default=None)
+    # front-door security (serve/aio.py) + slow-client eviction
+    p.add_argument("--tls-cert", default=None,
+                   help="PEM certificate chain: serve https on the "
+                        "asyncio transport (requires --tls-key)")
+    p.add_argument("--tls-key", default=None,
+                   help="PEM private key for --tls-cert")
+    p.add_argument("--auth-token", default=None,
+                   help="require 'Authorization: Bearer <token>' on "
+                        "every route except /healthz (401 otherwise)")
+    p.add_argument("--write-deadline-s", type=float, default=30.0,
+                   help="slow-client eviction: a stream whose client "
+                        "stops draining our writes for this long is "
+                        "aborted and its engine work cancelled")
     # observability / postmortem
     p.add_argument("--dir-interval-s", type=float, default=0.25,
                    help="refresh cadence for the /kvprefixes "
@@ -207,7 +220,10 @@ def build_frontend(a: argparse.Namespace):
         router_url=a.router_url,
         register_interval_s=a.register_interval_s,
         tier_spill_interval_s=a.tier_spill_interval_s,
-        phase=a.phase, tokenizer_seed=a.init_seed)
+        phase=a.phase, tokenizer_seed=a.init_seed,
+        tls_cert=a.tls_cert, tls_key=a.tls_key,
+        auth_token=a.auth_token,
+        write_deadline_s=a.write_deadline_s)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -111,13 +111,39 @@ fleet's one observability front door:
   threads with their own `scrape_timeout_s`, so one wedged replica
   cannot stall the loop past its interval — its staleness gauge just
   keeps growing while the rest of the fleet stays fresh.
+
+ASYNC FRONT DOOR (serve/aio.py). The router's connection layer is the
+same asyncio server the replicas use: every client stream is a
+coroutine on one acceptor-thread event loop, client disconnects come
+from the transport (the relay's write fails immediately, not at the
+next frame), and client writes are backpressured per-connection with a
+slow-client deadline — a stalled reader is aborted instead of pinning
+a relay. Upstream replica hops are plain asyncio connections
+(aio_http_request); the hedge race that used to burn two threads per
+hedged request is two coroutines on the same loop. Blocking sub-paths
+(scrape probes on /register, the /metrics/fleet and /trace fan-outs)
+run on the default executor — the router's thread count is constant
+in the number of attached clients, exactly like the replicas'.
+
+FLEET ADMISSION (opt-in: `fleet_admission`). The scrape loop already
+reads each replica's exposition; with admission on it also lifts the
+replica's own SLO burn-rate verdicts (`ptpu_slo_burning{objective=…}`
+gauges, obs/slo.py) into the routing table. A request whose planned
+primary is burning its error budget sheds HERE — 503 + Retry-After at
+the router, `ptpu_router_fleet_sheds_total{reason="primary_burn"}` —
+before the burning replica spends admission work on it, and is
+deliberately NOT spilled onto the healthy remainder (pushing a hot
+shard's traffic onto its neighbours is how one burning replica
+torches the fleet). When EVERY candidate is burning the request sheds
+reason="fleet_burn". Burn state is exported per replica as
+`ptpu_router_replica_burning` whether or not admission is enforcing.
 """
 
 from __future__ import annotations
 
+import asyncio
 import itertools
 import json
-import queue
 import re
 import signal
 import threading
@@ -125,7 +151,7 @@ import time
 import uuid
 import zlib
 from http.client import HTTPConnection
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.client import responses as _STATUS_TEXT
 from typing import Dict, List, Optional, Sequence, Tuple
 from urllib.parse import urlsplit
 
@@ -135,8 +161,12 @@ from paddle_tpu.obs.metrics import MetricsRegistry
 from paddle_tpu.obs.tracing import RequestTracer, stitch_fragments
 from paddle_tpu.resilience.errors import PREEMPT_EXIT_CODE
 from paddle_tpu.resilience.retry import RetryBudget
-from paddle_tpu.serve.sse import (DONE_SENTINEL, iter_sse,
-                                  parse_prometheus_values, sse_event)
+from paddle_tpu.serve.aio import (AioConnection, AioRequest,
+                                  AsyncHTTPServer, SlowClientError,
+                                  aio_http_request, aio_read_body,
+                                  aiter_sse, close_writer_abruptly)
+from paddle_tpu.serve.sse import (DONE_SENTINEL, parse_prometheus_values,
+                                  sse_event)
 from paddle_tpu.utils.log import serve_event
 
 
@@ -172,6 +202,11 @@ _PHASE_LEVEL = {"mixed": 0.0, "prefill": 1.0, "decode": 2.0}
 
 _LE_RE = re.compile(r'le="([^"]+)"')
 
+# a replica's own SLO burn verdict in its exposition (obs/slo.py):
+# ptpu_slo_burning{objective="queue_wait"} 1.0 while the short window
+# burns error budget faster than the alert threshold
+_SLO_BURN_RE = re.compile(r'^ptpu_slo_burning\{objective="([^"]+)"\}$')
+
 
 def _bucket_quantile(vals: dict, family: str, q: float) -> float:
     """histogram_quantile over a flat scrape dict (same walk as
@@ -206,7 +241,7 @@ class ReplicaState:
     __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
                  "queue_depth", "last_scrape", "prefixes", "fails",
                  "breaker", "open_until", "ttft_p95_ms", "registered",
-                 "scraping", "phase")
+                 "scraping", "phase", "burning")
 
     def __init__(self, url: str):
         parts = urlsplit(url)
@@ -231,6 +266,9 @@ class ReplicaState:
         # disaggregated serving phase (prefill|decode|mixed): from the
         # /register heartbeat or the /kvprefixes advertisement
         self.phase = "mixed"
+        # SLO objectives the replica itself reports as burning
+        # (ptpu_slo_burning gauges at 1.0) — fleet admission's input
+        self.burning: Tuple[str, ...] = ()
 
 
 class _RelayState:
@@ -244,6 +282,30 @@ class _RelayState:
     def __init__(self):
         self.started = False
         self.sent = 0
+
+
+class _Upstream:
+    """One open replica response: parsed status + lower-cased headers
+    plus the live reader for the close-delimited body. close() aborts
+    the transport (no FIN handshake) — dropping a replica stream this
+    way is what makes its engine cancel and free KV blocks."""
+
+    __slots__ = ("status", "headers", "reader", "writer")
+
+    def __init__(self, status: int, headers: Dict[str, str],
+                 reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.status = status
+        self.headers = headers
+        self.reader = reader
+        self.writer = writer
+
+    def getheader(self, name: str, default: Optional[str] = None
+                  ) -> Optional[str]:
+        return self.headers.get(name.lower(), default)
+
+    def close(self) -> None:
+        close_writer_abruptly(self.writer)
 
 
 class Router:
@@ -270,7 +332,8 @@ class Router:
                  hedge_min_s: float = 0.05,
                  hedge_max_s: float = 2.0,
                  kv_transfer: bool = False,
-                 phase_prefill_ratio: float = 2.0):
+                 phase_prefill_ratio: float = 2.0,
+                 fleet_admission: bool = False):
         self.replicas = [ReplicaState(u) for u in replica_urls]
         self.host = host
         self.port = port
@@ -296,6 +359,9 @@ class Router:
         # prompt_len >= ratio * max_new_tokens classifies a request as
         # prefill-heavy when phase-specialized replicas exist
         self.phase_prefill_ratio = phase_prefill_ratio
+        # opt-in: shed at the router when the planned replica reports
+        # ptpu_slo_burning (see the FLEET ADMISSION docstring section)
+        self.fleet_admission = fleet_admission
         self.exit_code: Optional[int] = None
 
         self.obs = MetricsRegistry()    # the router's OWN process story
@@ -369,6 +435,15 @@ class Router:
             "ptpu_router_replica_phase",
             "Replica's advertised serving phase: 0 mixed, 1 prefill, "
             "2 decode", labelnames=("replica",))
+        self._m_replica_burning = self.obs.gauge(
+            "ptpu_router_replica_burning",
+            "1 when the replica's own exposition reports any "
+            "ptpu_slo_burning objective alight", labelnames=("replica",))
+        self._m_fleet_sheds = self.obs.counter(
+            "ptpu_router_fleet_sheds_total",
+            "Requests shed at the router by fleet admission before a "
+            "burning replica saw them",
+            labelnames=("reason",))     # reason=primary_burn|fleet_burn
 
         # router-side spans under the fleet trace id: one synthetic
         # request id per proxied POST, stitched with the replica's
@@ -376,8 +451,7 @@ class Router:
         self.tracer = RequestTracer(keep_last=512, process_name="router")
         self._trace_seq = itertools.count(1)
 
-        self._server: Optional[ThreadingHTTPServer] = None
-        self._serve_thread: Optional[threading.Thread] = None
+        self._server: Optional[AsyncHTTPServer] = None
         self._scrape_thread: Optional[threading.Thread] = None
         self._stop_scrape = threading.Event()
         # One lock covers the router's mutable shared state: the in-flight
@@ -450,10 +524,10 @@ class Router:
             self._scrape_once(r)
         return r
 
-    def _handle_register(self, h: BaseHTTPRequestHandler) -> None:
+    async def _a_register(self, req: AioRequest,
+                          conn: AioConnection) -> None:
         try:
-            length = int(h.headers.get("Content-Length", "0"))
-            body = json.loads(h.rfile.read(length) or b"{}")
+            body = json.loads(req.body or b"{}")
             url = str(body.get("url") or "")
             phase = body.get("phase")
         except (ValueError, json.JSONDecodeError):
@@ -462,27 +536,19 @@ class Router:
             payload = json.dumps({"ok": False,
                                   "error": "body must be {'url': "
                                            "'http://host:port'}"})
-            self._send_json(h, 400, payload)
+            await conn.send(400, "application/json",
+                            payload.encode() + b"\n")
             return
-        r = self.register_replica(url, phase=phase)
+        # register_replica probes the new member over blocking HTTP:
+        # off the loop, onto the (bounded) default executor
+        r = await asyncio.get_running_loop().run_in_executor(
+            None, self.register_replica, url, phase)
         with self._lock:
             known = len(self.replicas)
             ready = r.ready
-        self._send_json(h, 200, json.dumps(
-            {"ok": True, "ready": ready, "replicas": known}))
-
-    @staticmethod
-    def _send_json(h: BaseHTTPRequestHandler, status: int,
-                   payload: str) -> None:
-        body = payload.encode() + b"\n"
-        try:
-            h.send_response(status)
-            h.send_header("Content-Type", "application/json")
-            h.send_header("Content-Length", str(len(body)))
-            h.end_headers()
-            h.wfile.write(body)
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        await conn.send(200, "application/json", json.dumps(
+            {"ok": True, "ready": ready, "replicas": known}).encode()
+            + b"\n")
 
     # -- scrape loop ------------------------------------------------------
     def _scrape_once(self, r: ReplicaState) -> None:
@@ -536,6 +602,11 @@ class Router:
             self._m_scrape_age.labels(replica=r.url).set(age)
             return
         ttft = _bucket_quantile(vals, "ptpu_serve_ttft_ms", 0.95)
+        # the replica's own SLO burn verdicts, straight from its
+        # exposition — fleet admission sheds on these (when enabled)
+        burning = tuple(sorted(
+            m.group(1) for key, val in vals.items()
+            for m in (_SLO_BURN_RE.match(key),) if m and val >= 1.0))
         with self._lock:
             rejoined = r.breaker != "closed"
             r.breaker = "closed"
@@ -544,6 +615,7 @@ class Router:
             r.ready = ready
             r.reason = reason
             r.prefixes = prefixes
+            r.burning = burning
             if phase is not None:
                 r.phase = phase
             phase_pub = r.phase
@@ -567,6 +639,8 @@ class Router:
         self._m_replica_ttft.labels(replica=r.url).set(ttft_pub)
         self._m_replica_phase.labels(replica=r.url).set(
             _PHASE_LEVEL[phase_pub])
+        self._m_replica_burning.labels(replica=r.url).set(
+            1.0 if burning else 0.0)
         # staleness: keeps GROWING while scrapes fail, so alerting can
         # tell "replica down" from "replica briefly slow"
         age = (time.monotonic() - last_scrape) if last_scrape else -1.0
@@ -748,25 +822,10 @@ class Router:
         self._scrape_thread = threading.Thread(
             target=self._scrape_loop, daemon=True, name="ptpu-router-scrape")
         self._scrape_thread.start()
-        outer = self
-
-        class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):                       # noqa: N802
-                outer._handle_get(self)
-
-            def do_POST(self):                      # noqa: N802
-                outer._handle_post(self)
-
-            def log_message(self, *args):
-                pass
-
-        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
-        self._server.daemon_threads = True
-        self.port = self._server.server_address[1]
-        self._serve_thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="ptpu-router-http")
-        self._serve_thread.start()
+        self._server = AsyncHTTPServer(
+            self.host, self.port, self._a_dispatch,
+            name="ptpu-router-http").start()
+        self.port = self._server.port
         serve_event("router_listening", host=self.host, port=self.port,
                     replicas=[r.url for r in self.replicas])
         return self
@@ -809,12 +868,8 @@ class Router:
     def stop(self) -> None:
         self._stop_scrape.set()
         if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
-        if self._serve_thread is not None:
-            self._serve_thread.join(timeout=5)
-            self._serve_thread = None
+            server, self._server = self._server, None
+            server.stop()
         if self._scrape_thread is not None:
             self._scrape_thread.join(timeout=5)
             self._scrape_thread = None
@@ -912,6 +967,7 @@ class Router:
                 "registered": r.registered,
                 "ttft_p95_ms": r.ttft_p95_ms,
                 "phase": r.phase,
+                "burning": list(r.burning),
             } for r in self.replicas]
             inflight = self._inflight
             draining = self._draining
@@ -921,55 +977,94 @@ class Router:
                 "directory_enabled": self.enable_directory,
                 "retry_budget_tokens": self.retry_budget.tokens(),
                 "hedge_enabled": self.enable_hedge,
-                "kv_transfer": self.kv_transfer}
+                "kv_transfer": self.kv_transfer,
+                "fleet_admission": self.fleet_admission}
 
-    def _handle_get(self, h: BaseHTTPRequestHandler) -> None:
+    def _get_response(self, path: str) -> Tuple[int, str, bytes]:
+        """Resolve a GET path to (status, ctype, body). Runs on an
+        executor thread: /metrics/fleet and /trace/<id> fan blocking
+        GETs over the whole fleet and must never park the loop."""
         resp = obs_response(
-            h.path, self.obs, readiness=self.readiness,
+            path, self.obs, readiness=self.readiness,
             routes={"/metrics/fleet": self._fleet_route,
                     "/debug": json_route(self._debug_payload)},
             prefix_routes={"/trace/": self._trace_route})
         if resp is None:
             resp = (404, "text/plain", b"not found\n")
-        status, ctype, body = resp
-        try:
-            h.send_response(status)
-            h.send_header("Content-Type", ctype)
-            h.send_header("Content-Length", str(len(body)))
-            h.end_headers()
-            h.wfile.write(body)
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        return resp
 
-    def _shed(self, h: BaseHTTPRequestHandler, reason: str) -> None:
+    async def _a_get(self, req: AioRequest, conn: AioConnection) -> None:
+        resp = await asyncio.get_running_loop().run_in_executor(
+            None, self._get_response, req.path)
+        await conn.send(*resp)
+
+    async def _a_shed(self, conn: AioConnection, reason: str) -> None:
         self._m_sheds.labels(reason=reason).inc()
         body = json.dumps({"error": "overloaded", "reason": reason,
                            "retry_after_s": 1.0}).encode() + b"\n"
         try:
-            h.send_response(503)
-            h.send_header("Content-Type", "application/json")
-            h.send_header("Content-Length", str(len(body)))
-            h.send_header("Retry-After", "1")
-            h.end_headers()
-            h.wfile.write(body)
-        except (BrokenPipeError, ConnectionResetError):
+            await conn.send(503, "application/json", body,
+                            {"Retry-After": "1"})
+        except (SlowClientError, ConnectionError, OSError):
             pass
 
-    def _handle_post(self, h: BaseHTTPRequestHandler) -> None:
-        path = h.path.split("?")[0]
+    async def _a_fleet_shed(self, conn: AioConnection,
+                            reason: str) -> None:
+        """Fleet admission's bounce: same 503 + Retry-After contract
+        as _a_shed but counted on its own series — "the fleet is
+        protecting itself" is a different signal from "the router has
+        nowhere to route"."""
+        self._m_fleet_sheds.labels(reason=reason).inc()
+        serve_event("router_fleet_shed", reason=reason)
+        body = json.dumps({"error": "overloaded", "reason": reason,
+                           "retry_after_s": 1.0}).encode() + b"\n"
+        try:
+            await conn.send(503, "application/json", body,
+                            {"Retry-After": "1"})
+        except (SlowClientError, ConnectionError, OSError):
+            pass
+
+    def _fleet_admission_reason(
+            self, candidates: List[ReplicaState]) -> Optional[str]:
+        """None admits. "primary_burn" when the planned primary's own
+        SLO monitor says it is burning error budget — the request is
+        shed, deliberately NOT spilled onto the healthy remainder
+        (pushing a hot shard's traffic onto its neighbours is how one
+        burning replica torches the fleet). "fleet_burn" when every
+        candidate is burning."""
+        if not self.fleet_admission or not candidates:
+            return None
+        with self._lock:
+            burning = [bool(r.burning) for r in candidates]
+        if all(burning):
+            return "fleet_burn"
+        if burning[0]:
+            return "primary_burn"
+        return None
+
+    async def _a_dispatch(self, req: AioRequest,
+                          conn: AioConnection) -> None:
+        if req.method == "GET":
+            await self._a_get(req, conn)
+        elif req.method == "POST":
+            await self._a_post(req, conn)
+        else:
+            await conn.send(405, "text/plain", b"method not allowed\n")
+
+    async def _a_post(self, req: AioRequest, conn: AioConnection) -> None:
+        path = req.path.split("?")[0]
         if path == "/register":
-            self._handle_register(h)
+            await self._a_register(req, conn)
             return
         if path != "/v1/completions":
-            self._handle_get(h)         # reuse the 404 path
+            await self._a_get(req, conn)    # reuse the 404 path
             return
         if self._draining:
-            self._shed(h, "draining")
+            await self._a_shed(conn, "draining")
             return
         max_new: Optional[int] = None
+        raw = req.body or b"{}"
         try:
-            length = int(h.headers.get("Content-Length", "0"))
-            raw = h.rfile.read(length)
             body = json.loads(raw or b"{}")
             prompt = body.get("prompt") or []
             if isinstance(prompt, str):
@@ -986,7 +1081,7 @@ class Router:
         # fleet trace id: honor the client's, else mint one; the same
         # id tags the router's route/relay spans AND rides the replica
         # hop as x-ptpu-trace, so /trace/<id> can stitch both processes
-        tid = h.headers.get("x-ptpu-trace") or uuid.uuid4().hex[:16]
+        tid = req.header("x-ptpu-trace") or uuid.uuid4().hex[:16]
         rid = next(self._trace_seq)
         self.tracer.set_trace_id(rid, tid)
         self.tracer.span_begin(rid, "route")
@@ -994,14 +1089,19 @@ class Router:
             prompt, max_new)
         if not candidates:
             self.tracer.on_finish(rid, "shed")
-            self._shed(h, "no_replica")
+            await self._a_shed(conn, "no_replica")
+            return
+        fleet_reason = self._fleet_admission_reason(candidates)
+        if fleet_reason is not None:
+            self.tracer.on_finish(rid, "shed")
+            await self._a_fleet_shed(conn, fleet_reason)
             return
         if want is not None:
             self._m_phase_routed.labels(phase=want).inc()
         self._track_inflight(+1)
         try:
-            self._proxy(h, raw, prompt, candidates, dir_pick, sticky,
-                        dir_len=dir_len, tid=tid, rid=rid)
+            await self._a_proxy(conn, raw, prompt, candidates, dir_pick,
+                                sticky, dir_len=dir_len, tid=tid, rid=rid)
         finally:
             self._track_inflight(-1)
 
@@ -1016,24 +1116,27 @@ class Router:
             self._m_inflight.set(float(self._inflight))
 
     # -- proxy data path --------------------------------------------------
-    def _connect_stream(self, r: ReplicaState, raw: bytes,
-                        headers: dict):
+    async def _a_connect_stream(self, r: ReplicaState, raw: bytes,
+                                headers: dict):
         """POST the completion to one replica.
-        ("ok", conn, resp) | ("shed", body) | ("error",)."""
+        ("ok", _Upstream) | ("shed", body) | ("error",)."""
         try:
-            conn = HTTPConnection(r.host, r.port,
-                                  timeout=self.connect_timeout_s)
-            conn.request(
-                "POST", "/v1/completions", body=raw, headers=headers)
-            resp = conn.getresponse()
-        except OSError as e:
+            status, rheaders, reader, writer = await aio_http_request(
+                r.host, r.port, "POST", "/v1/completions", body=raw,
+                headers=headers, connect_timeout_s=self.connect_timeout_s)
+        except (OSError, asyncio.TimeoutError) as e:
             self._note_failure(r, f"connect failed: {e}")
             return ("error",)
-        if resp.status == 503:      # replica shed: caller tries the next
-            body = resp.read()
-            conn.close()
+        up = _Upstream(status, rheaders, reader, writer)
+        if status == 503:           # replica shed: caller tries the next
+            try:
+                body = await aio_read_body(
+                    reader, rheaders, timeout_s=self.connect_timeout_s)
+            except asyncio.TimeoutError:
+                body = b""
+            up.close()
             return ("shed", body)
-        return ("ok", conn, resp)
+        return ("ok", up)
 
     def _hedge_delay_s(self, r: ReplicaState) -> float:
         """How long to give `r`'s first response byte before hedging:
@@ -1050,153 +1153,163 @@ class Router:
         return min(max(self.hedge_ttft_mult * p95 / 1000.0,
                        self.hedge_min_s), self.hedge_max_s)
 
-    def _open_stream(self, r: ReplicaState, raw: bytes, headers: dict,
-                     hedge_pool: Optional[List[ReplicaState]],
-                     rid: Optional[int]):
+    async def _a_open_stream(self, r: ReplicaState, raw: bytes,
+                             headers: dict,
+                             hedge_pool: Optional[List[ReplicaState]],
+                             rid: Optional[int]):
         """Open the stream on `r`; with a non-empty `hedge_pool`, race
         ONE hedge to its head after the TTFT-derived delay — first
-        response wins, the loser's connection is closed (the engine
+        response wins, the loser's connection is aborted (the engine
         behind it cancels and frees KV). The hedge spends a retry-
         budget token when it fires; an empty bucket silently skips it.
-        Returns ("ok", replica, conn, resp) | ("shed", body) |
+        The race that used to burn two threads per hedged request is
+        two coroutines on the serving loop.
+        Returns ("ok", replica, _Upstream) | ("shed", body) |
         ("error",)."""
         if not hedge_pool:
-            res = self._connect_stream(r, raw, headers)
-            return res if res[0] != "ok" else ("ok", r, res[1], res[2])
+            res = await self._a_connect_stream(r, raw, headers)
+            return res if res[0] != "ok" else ("ok", r, res[1])
         delay = self._hedge_delay_s(r)
-        results: "queue.Queue" = queue.Queue()
-        decided = threading.Event()
-        fired = threading.Event()
+        decided = asyncio.Event()
+        fired = False
         hedge_target = hedge_pool[0]
 
-        def attempt(rep: ReplicaState, tag: str, wait_s: float) -> None:
-            if wait_s > 0.0 and decided.wait(wait_s):
-                return                  # first answered before the delay
+        async def attempt(rep: ReplicaState, tag: str, wait_s: float):
+            nonlocal fired
+            if wait_s > 0.0:
+                try:
+                    await asyncio.wait_for(decided.wait(), wait_s)
+                    return (tag, rep, None)     # first answered in time
+                except asyncio.TimeoutError:
+                    pass
             if tag == "hedge":
                 if not self.retry_budget.try_spend("router_hedge"):
                     self._m_hedges.labels(outcome="denied").inc()
-                    results.put((tag, rep, ("error",)))
-                    return
-                fired.set()
+                    return (tag, rep, ("error",))
+                fired = True
                 if rid is not None:
                     self.tracer.mark(rid, "hedge_fired", replica=rep.url)
-            results.put((tag, rep, self._connect_stream(rep, raw, headers)))
+            return (tag, rep,
+                    await self._a_connect_stream(rep, raw, headers))
 
-        threads = [
-            threading.Thread(target=attempt, args=(r, "first", 0.0),
-                             daemon=True),
-            threading.Thread(target=attempt,
-                             args=(hedge_target, "hedge", delay),
-                             daemon=True)]
-        for t in threads:
-            t.start()
+        loop = asyncio.get_running_loop()
+        tasks = {loop.create_task(attempt(r, "first", 0.0)),
+                 loop.create_task(attempt(hedge_target, "hedge", delay))}
         chosen = None
         first_failure = None
-        outstanding = 2
-        overall = self.connect_timeout_s + delay + 1.0
-        endline = time.monotonic() + overall
-        while outstanding > 0 and chosen is None:
-            try:
-                tag, rep, res = results.get(
-                    timeout=max(0.1, endline - time.monotonic()))
-            except queue.Empty:
+        endline = loop.time() + self.connect_timeout_s + delay + 1.0
+        while tasks and chosen is None:
+            timeout = endline - loop.time()
+            if timeout <= 0:
                 break
-            outstanding -= 1
-            if res[0] == "ok":
-                chosen = (tag, rep, res)
-            elif tag == "first":
-                first_failure = res
-                if not fired.is_set():
-                    # the primary failed before any hedge went out:
-                    # cancel the sleeping hedge and fail over normally
-                    decided.set()
-                    return first_failure
-            # a failed hedge: keep waiting for the primary
+            done, tasks = await asyncio.wait(
+                tasks, timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                tag, rep, res = t.result()
+                if res is None:
+                    continue            # hedge stood down: first decided
+                if res[0] == "ok":
+                    if chosen is None:
+                        chosen = (tag, rep, res)
+                    else:               # both landed: drop the loser
+                        res[1].close()
+                elif tag == "first":
+                    first_failure = res
+                    if not fired:
+                        # the primary failed before any hedge went out:
+                        # stand the hedge down and fail over normally
+                        decided.set()
+                        await asyncio.gather(*tasks,
+                                             return_exceptions=True)
+                        return first_failure
+                # a failed hedge: keep waiting for the primary
         decided.set()
         if chosen is None:
+            for t in tasks:
+                t.cancel()
             return first_failure if first_failure is not None else ("error",)
         tag, rep, res = chosen
         if tag == "hedge":
             self._m_hedges.labels(outcome="won").inc()
-        elif fired.is_set():
+        elif fired:
             self._m_hedges.labels(outcome="lost").inc()
-        if outstanding > 0:
-            # the loser is still connecting/streaming: reap its socket
-            # when it resolves so the engine behind it cancels
-            def reap(n: int) -> None:
-                for _ in range(n):
-                    try:
-                        _, _, late = results.get(
-                            timeout=self.connect_timeout_s + 5.0)
-                    except queue.Empty:
-                        return
-                    if late[0] == "ok":
-                        for obj in (late[2], late[1]):
-                            try:
-                                obj.close()
-                            except OSError:
-                                pass
-            threading.Thread(target=reap, args=(outstanding,),
-                             daemon=True).start()
-        return ("ok", rep, res[1], res[2])
+        if tasks:
+            # the loser is still connecting: reap its socket when it
+            # resolves so the engine behind it cancels
+            async def _reap(pending):
+                done, late = await asyncio.wait(
+                    pending, timeout=self.connect_timeout_s + 5.0)
+                for t in late:
+                    t.cancel()
+                for t in done:
+                    _, _, lres = t.result()
+                    if lres is not None and lres[0] == "ok":
+                        lres[1].close()
+            loop.create_task(_reap(set(tasks)))
+        return ("ok", rep, res[1])
 
-    def _client_write(self, h: BaseHTTPRequestHandler,
-                      data: bytes) -> bool:
+    @staticmethod
+    async def _a_client_write(conn: AioConnection, data: bytes) -> bool:
+        """True when the client took the bytes; False when it hung up
+        or stalled past the write deadline (its transport is already
+        aborted by then)."""
         try:
-            h.wfile.write(data)
-            h.wfile.flush()
+            await conn.write(data)
             return True
-        except OSError:
+        except (SlowClientError, ConnectionError, OSError):
             return False
 
-    def _relay_sse(self, h: BaseHTTPRequestHandler, resp,
-                   state: _RelayState) -> str:
+    async def _a_relay_sse(self, conn: AioConnection, up: _Upstream,
+                           state: _RelayState) -> str:
         """Frame-level relay: forward SSE frames as they arrive,
         skipping the first `state.sent` data frames (a resumed stream
         replays from the start — greedy decode on identical weights
         makes the replay identical). Returns "done" ([DONE] relayed /
         non-stream response fully copied), "client_gone" (our write
-        failed), or "truncated" (upstream died first — the caller
-        fails over)."""
-        ctype = resp.getheader("Content-Type", "") or ""
-        if resp.status != 200 or "text/event-stream" not in ctype:
+        failed or the client stalled past the write deadline), or
+        "truncated" (upstream died first — the caller fails over)."""
+        ctype = up.getheader("content-type", "") or ""
+        if up.status != 200 or "text/event-stream" not in ctype:
             if state.started:
                 return "truncated"  # can't splice a non-stream mid-stream
-            self._relay(h, resp)
+            await self._a_relay(conn, up)
             return "done"
         if not state.started:
-            try:
-                h.send_response(200)
-                h.send_header("Content-Type", ctype)
-                h.end_headers()
-            except OSError:
+            head = ("HTTP/1.0 200 OK\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    "Connection: close\r\n\r\n").encode("latin-1")
+            if not await self._a_client_write(conn, head):
                 return "client_gone"
             state.started = True
         n = 0
         try:
-            for payload in iter_sse(resp):
+            async for payload in aiter_sse(
+                    up.reader, timeout_s=self.connect_timeout_s):
                 if payload == DONE_SENTINEL:
-                    if not self._client_write(h, sse_event(payload)):
+                    if not await self._a_client_write(
+                            conn, sse_event(payload)):
                         return "client_gone"
                     return "done"
                 n += 1
                 if n <= state.sent:
                     continue        # the client already has this frame
-                if not self._client_write(h, sse_event(payload)):
+                if not await self._a_client_write(
+                        conn, sse_event(payload)):
                     return "client_gone"
                 state.sent = n
-        except OSError:             # read timeout / reset from upstream
-            pass
+        except (OSError, asyncio.TimeoutError):
+            pass                    # reset / stall from upstream
         return "truncated"          # EOF without [DONE]
 
-    def _proxy(self, h: BaseHTTPRequestHandler, raw: bytes,
-               prompt: Sequence[int],
-               candidates: List[ReplicaState],
-               dir_pick: Optional[ReplicaState] = None,
-               sticky: Optional[ReplicaState] = None, *,
-               dir_len: int = 0,
-               tid: Optional[str] = None,
-               rid: Optional[int] = None) -> None:
+    async def _a_proxy(self, conn: AioConnection, raw: bytes,
+                       prompt: Sequence[int],
+                       candidates: List[ReplicaState],
+                       dir_pick: Optional[ReplicaState] = None,
+                       sticky: Optional[ReplicaState] = None, *,
+                       dir_len: int = 0,
+                       tid: Optional[str] = None,
+                       rid: Optional[int] = None) -> None:
         """Drive one request to a `[DONE]`-terminated stream across as
         many replicas as the retry budget allows: connect failures and
         replica 503s fail over BEFORE the first byte; a mid-stream
@@ -1225,7 +1338,7 @@ class Router:
                     if rid is not None:
                         self.tracer.on_finish(rid, "budget_exhausted")
                     if not state.started:
-                        self._shed(h, "retry_budget")
+                        await self._a_shed(conn, "retry_budget")
                     return
                 self._m_retries.labels(kind=retry_kind).inc()
                 if rid is not None:
@@ -1244,8 +1357,8 @@ class Router:
                 attempt_headers = dict(headers)
                 attempt_headers["x-ptpu-kv-source"] = dir_pick.url
                 attempt_headers["x-ptpu-kv-len"] = str(dir_len)
-            res = self._open_stream(r, raw, attempt_headers,
-                                    hedge_pool, rid)
+            res = await self._a_open_stream(r, raw, attempt_headers,
+                                            hedge_pool, rid)
             if res[0] == "shed":
                 last_shed = res[1]
                 retry_kind = "shed"
@@ -1257,7 +1370,7 @@ class Router:
                 if rid is not None:
                     self.tracer.mark(rid, "connect_failed", replica=r.url)
                 continue
-            _, r_used, conn, resp = res
+            _, r_used, up = res
             if r_used is not r:
                 # the hedge won: it came out of pending; the slow
                 # primary goes to the back as a last-resort retry
@@ -1282,8 +1395,8 @@ class Router:
                 self.tracer.mark(rid, "routed", replica=r_used.url,
                                  kind=kind)
                 self.tracer.span_begin(rid, "relay")
-            outcome = self._relay_sse(h, resp, state)
-            conn.close()
+            outcome = await self._a_relay_sse(conn, up, state)
+            up.close()
             if outcome == "done":
                 if rid is not None:
                     self.tracer.on_finish(rid, "relayed")
@@ -1305,37 +1418,34 @@ class Router:
             return      # partial stream, nothing left to resume from
         if last_shed is not None:       # every replica shed: relay it
             try:
-                h.send_response(503)
-                h.send_header("Content-Type", "application/json")
-                h.send_header("Content-Length", str(len(last_shed)))
-                h.end_headers()
-                h.wfile.write(last_shed)
-            except (BrokenPipeError, ConnectionResetError):
+                await conn.send(503, "application/json", last_shed)
+            except (SlowClientError, ConnectionError, OSError):
                 pass
             return
-        self._shed(h, "no_replica")
+        await self._a_shed(conn, "no_replica")
 
-    @staticmethod
-    def _relay(h: BaseHTTPRequestHandler, resp) -> None:
+    async def _a_relay(self, conn: AioConnection, up: _Upstream) -> None:
         """Copy status + content-type + body bytes to the client,
-        unbuffered per read so tokens stream as they arrive. A client
-        write failure closes the replica socket (via the caller's
-        conn.close()), which cancels the request engine-side. The
+        unbuffered per read so bytes stream as they arrive. A client
+        write failure aborts the replica connection (via the caller's
+        up.close()), which cancels the request engine-side. The
         non-SSE path (errors, future non-stream responses); SSE goes
-        through _relay_sse for failover-with-resume."""
+        through _a_relay_sse for failover-with-resume."""
+        ctype = up.getheader("content-type", "application/octet-stream")
+        head = (f"HTTP/1.0 {up.status} {_STATUS_TEXT.get(up.status, '')}"
+                f"\r\nContent-Type: {ctype}\r\n"
+                "Connection: close\r\n\r\n").encode("latin-1")
+        if not await self._a_client_write(conn, head):
+            return
         try:
-            h.send_response(resp.status)
-            ctype = resp.getheader("Content-Type", "application/octet-stream")
-            h.send_header("Content-Type", ctype)
-            h.end_headers()
             while True:
-                chunk = resp.read1(8192) if hasattr(resp, "read1") \
-                    else resp.read(8192)
+                chunk = await asyncio.wait_for(
+                    up.reader.read(8192), self.connect_timeout_s)
                 if not chunk:
                     break
-                h.wfile.write(chunk)
-                h.wfile.flush()
-        except (BrokenPipeError, ConnectionResetError, OSError):
+                if not await self._a_client_write(conn, chunk):
+                    return
+        except (OSError, asyncio.TimeoutError):
             pass
 
 
@@ -1382,6 +1492,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--phase-prefill-ratio", type=float, default=2.0,
                    help="prompt len >= ratio * max_new_tokens routes "
                         "to prefill-phase replicas when any exist")
+    p.add_argument("--fleet-admission", action="store_true",
+                   help="shed (503 + Retry-After) at the router when "
+                        "the planned replica reports ptpu_slo_burning")
     a = p.parse_args(argv)
     router = Router(a.replica, host=a.host, port=a.port,
                     prefix_len=a.prefix_len,
@@ -1398,7 +1511,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     hedge_min_s=a.hedge_min_s,
                     hedge_max_s=a.hedge_max_s,
                     kv_transfer=a.kv_transfer,
-                    phase_prefill_ratio=a.phase_prefill_ratio)
+                    phase_prefill_ratio=a.phase_prefill_ratio,
+                    fleet_admission=a.fleet_admission)
     router.start().install_signals()
     code = router.wait()
     router.stop()

@@ -16,7 +16,8 @@ into `engine.cancel()`.
 from __future__ import annotations
 
 import json
-from http.client import HTTPConnection
+import ssl
+from http.client import HTTPConnection, HTTPSConnection
 from typing import Iterator, Optional, Tuple
 from urllib.parse import urlsplit
 
@@ -93,8 +94,16 @@ class SSEStream:
 
 def _connect(url: str, timeout: float) -> Tuple[HTTPConnection, str]:
     parts = urlsplit(url)
-    conn = HTTPConnection(parts.hostname, parts.port or 80,
-                          timeout=timeout)
+    if parts.scheme == "https":
+        # serve fronts run self-signed certs (make_server_tls_context):
+        # encrypt the hop, skip hostname/CA verification — this client
+        # talks to replicas it just started, not the open internet
+        conn: HTTPConnection = HTTPSConnection(
+            parts.hostname, parts.port or 443, timeout=timeout,
+            context=ssl._create_unverified_context())
+    else:
+        conn = HTTPConnection(parts.hostname, parts.port or 80,
+                              timeout=timeout)
     path = parts.path or "/"
     if parts.query:
         path += "?" + parts.query

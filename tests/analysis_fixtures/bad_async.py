@@ -1,0 +1,28 @@
+"""Known-bad async-safety fixture: blocking calls on the event loop."""
+import asyncio
+import queue
+import socket
+import time
+from http.client import HTTPConnection
+
+
+class BlockingCoroutines:
+    def __init__(self, engine):
+        self.engine = engine
+        self.jobs = queue.Queue()
+
+    async def naps_the_loop(self):
+        time.sleep(0.5)                             # expect: AS001
+        await asyncio.sleep(0)
+
+    async def sync_socket(self, host):
+        return socket.create_connection((host, 80))  # expect: AS001
+
+    async def sync_http_client(self, host):
+        return HTTPConnection(host, 80)             # expect: AS001
+
+    async def unbounded_queue_get(self):
+        return self.jobs.get()                      # expect: AS001
+
+    async def engine_step_on_loop(self, prompt):
+        return self.engine.generate(prompt)         # expect: AS001

@@ -1,0 +1,41 @@
+"""Known-good async fixture: the loop-safe counterparts AS001 allows."""
+import asyncio
+import time
+
+
+class LoopSafe:
+    def __init__(self, engine):
+        self.engine = engine
+        self.jobs = asyncio.Queue()
+
+    async def waits_async(self):
+        await asyncio.sleep(0)
+
+    async def awaited_queue_get(self):
+        return await self.jobs.get()
+
+    async def bounded_wait(self):
+        return await asyncio.wait_for(self.jobs.get(), 1.0)
+
+    async def nowait_drain(self):
+        try:
+            return self.jobs.get_nowait()
+        except asyncio.QueueEmpty:
+            return None
+
+    async def dict_get_is_fine(self, opts):
+        return opts.get("key")
+
+    async def timeout_get_is_bounded(self, sync_q):
+        return sync_q.get(timeout=0.1)
+
+    async def executor_offload(self):
+        def probe():
+            time.sleep(0.0)     # runs on an executor, not the loop
+            return self.engine.generate([1])
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, probe)
+
+    def sync_helper(self, sync_q):
+        # sync code may block freely: it runs on its own thread
+        return sync_q.get()

@@ -66,9 +66,9 @@ def test_repo_gate_is_green():
 # -- fixture-driven pass tests ----------------------------------------------
 
 BAD = ["bad_trace.py", "bad_locks.py", "bad_telemetry.py", "bad_hygiene.py",
-       "bad_routes.py"]
+       "bad_routes.py", "bad_async.py"]
 GOOD = ["good_trace.py", "good_locks.py", "good_telemetry.py",
-        "good_hygiene.py"]
+        "good_hygiene.py", "good_async.py"]
 
 
 def test_bad_fixtures_flag_exactly_the_expected_rules():

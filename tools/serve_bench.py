@@ -1,6 +1,6 @@
 """Serving microbench: batching, prefix sharing, chunked prefill, telemetry.
 
-Ten scenarios, each an acceptance property of the serving stack
+Thirteen scenarios, each an acceptance property of the serving stack
 (ENGINE.md / OBSERVABILITY.md). The in-process scenarios run on the
 SAME model with EXACT token identity (greedy decode — the engine's
 batching/sharing/chunking invariance makes identity, not closeness,
@@ -95,6 +95,26 @@ drives them over HTTP:
            warm hit byte-identical to the cold pass with revived
            (not re-prefilled) blocks — compile gauge pinned at 1 on
            every replica throughout.
+- soak:    the asyncio front door's scaling claim (serve/aio.py). One
+           batch-limited replica holds --soak-streams (default 512)
+           CONCURRENT SSE streams, driven from a single client event
+           loop: zero failed, zero truncated, every stream
+           byte-identical to the in-process engine path on identical
+           weights, ptpu_serve_open_connections climbs past the
+           stream count while ptpu_serve_conn_threads stays FLAT
+           (engine loop + acceptor + a constant — connections are
+           coroutines, not threads), compile gauge exactly 1, and
+           the p99 per-token write+drain latency recorded from
+           ptpu_serve_token_write_seconds.
+- fleet_admission: the router's fleet-wide admission control. One
+           replica of a two-replica fleet is driven into SLO burn by
+           direct overload; the router (--fleet-admission) scrapes
+           the ptpu_slo_burning verdict and sheds that replica's
+           shard AT THE FRONT DOOR (ptpu_router_fleet_sheds_total >
+           0, 503 + Retry-After, deliberately NOT spilled onto the
+           healthy neighbour) while the healthy replica's shard is
+           served in full: 0 failed, 0 truncated, 0 sheds on the
+           healthy replica.
 
 Verdict inputs come from the metrics REGISTRY (paddle_tpu/obs/) — the
 same TTFT/TPOT/hit-rate/step-latency series a production scrape reads
@@ -111,7 +131,7 @@ Exit code: 0 iff every scenario's verdict holds.
 
 Run: python tools/serve_bench.py
      [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|tp|
-                 router|fleet_chaos]
+                 router|fleet_chaos|disagg|soak|fleet_admission]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
      [--trace-out FILE]     # dump the last in-process verdict engine's
@@ -1817,12 +1837,268 @@ def scenario_disagg(model, variables, args):
     return ok
 
 
+# -- scenario: soak — hundreds of concurrent SSE streams, flat threads -----
+
+def _soak_drive(base, payloads, ramp, frame_timeout_s=300.0):
+    """Open every stream CONCURRENTLY from one client event loop —
+    the bench-side mirror of the server's coroutine-per-stream model
+    (one OS thread holds all of them; a thread-per-stream client
+    would hit its own scaling wall first). `ramp` throttles
+    simultaneous CONNECT attempts only — opened streams all stay
+    live. Returns per-stream {status, tokens, done}."""
+    import asyncio
+    from urllib.parse import urlsplit
+
+    from paddle_tpu.serve.aio import aio_http_request, aiter_sse
+    from paddle_tpu.serve.sse import DONE_SENTINEL
+
+    parts = urlsplit(base)
+
+    async def one(payload, sem):
+        out = {"status": 0, "tokens": [], "done": False}
+        try:
+            async with sem:
+                status, _, reader, writer = await aio_http_request(
+                    parts.hostname, parts.port, "POST",
+                    "/v1/completions", body=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"},
+                    connect_timeout_s=120.0)
+            out["status"] = status
+            if status != 200:
+                writer.transport.abort()
+                return out
+            async for frame in aiter_sse(reader,
+                                         timeout_s=frame_timeout_s):
+                if frame == DONE_SENTINEL:
+                    out["done"] = True
+                    break
+                evt = json.loads(frame)
+                if "token" in evt:
+                    out["tokens"].append(evt["token"])
+            writer.close()
+        except (OSError, asyncio.TimeoutError) as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+        return out
+
+    async def drive():
+        sem = asyncio.Semaphore(ramp)
+        return list(await asyncio.gather(
+            *(one(p, sem) for p in payloads)))
+
+    return asyncio.run(drive())
+
+
+def scenario_soak(model, variables, args):
+    """The asyncio front door's scaling claim, measured: one
+    batch-limited replica holds `--soak-streams` (default 512)
+    concurrent SSE streams. Verdict: zero failed, zero truncated,
+    every stream byte-identical to the in-process engine path on
+    identical weights (the pre-port baseline), the OS thread count
+    FLAT while `ptpu_serve_open_connections` climbs past the stream
+    count, compile gauge exactly 1; p99 per-token write+drain latency
+    recorded from `ptpu_serve_token_write_seconds`."""
+    del model, variables
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.engine.engine import ServeEngine
+    from paddle_tpu.models.transformer import CausalLM
+    from paddle_tpu.obs.metrics import MetricsRegistry
+
+    n = args.soak_streams
+    new_tokens = args.soak_new_tokens
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, _REPLICA_VOCAB - 1, 6).tolist()
+               for _ in range(8)]
+    payloads = [{"prompt": prompts[i % len(prompts)],
+                 "max_new_tokens": new_tokens, "stream": True}
+                for i in range(n)]
+
+    # the PRE-PORT reference: the engine path itself, in process, on
+    # the replica CLI's default model (same seed -> same weights) —
+    # the front door must relay it byte-identically at any connection
+    # count
+    ref_model = CausalLM(vocab=_REPLICA_VOCAB, model_dim=16,
+                         num_heads=4, num_layers=2, ffn_dim=32,
+                         dropout=0.0, max_len=64)
+    ref_vars = ref_model.init(jax.random.PRNGKey(0),
+                              jnp.zeros((1, 4), jnp.int32))
+    ref_eng = ServeEngine(ref_model, ref_vars, max_batch_size=4,
+                          block_size=4, num_blocks=64,
+                          registry=MetricsRegistry())
+    want = {tuple(p): ref_eng.generate([p], max_new_tokens=new_tokens)[0]
+            for p in prompts}
+
+    # SLO thresholds parked at infinity: a deep queue on a batch-4
+    # replica is the POINT of the soak, not an overload to shed on
+    proc, base = _spawn_replica(extra=(
+        "--max-queue-depth", str(2 * n),
+        "--slo-ttft-ms", "1e9", "--slo-tpot-ms", "1e9",
+        "--slo-queue-wait-ms", "1e9"))
+    try:
+        _wait_for(lambda: _scrape(base).get("ptpu_serve_ready") == 1.0,
+                  30.0)
+        base_threads = _scrape(base).get("ptpu_serve_conn_threads", 0.0)
+
+        peak = {"conns": 0.0, "threads": 0.0}
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                try:
+                    v = _scrape(base)
+                except OSError:
+                    v = {}
+                peak["conns"] = max(
+                    peak["conns"],
+                    v.get("ptpu_serve_open_connections", 0.0))
+                peak["threads"] = max(
+                    peak["threads"],
+                    v.get("ptpu_serve_conn_threads", 0.0))
+                stop.wait(0.05)
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+        t0 = time.monotonic()
+        results = _soak_drive(base, payloads, ramp=args.soak_ramp)
+        wall_s = time.monotonic() - t0
+        stop.set()
+        sampler.join(timeout=5)
+        final = _scrape(base)
+    finally:
+        _terminate(proc)
+
+    failed = sum(1 for r in results if r["status"] != 200)
+    truncated = sum(1 for r in results
+                    if r["status"] == 200 and not r["done"])
+    identical = all(r["tokens"] == want[tuple(p["prompt"])]
+                    for r, p in zip(results, payloads)
+                    if r["status"] == 200)
+    p99_write_s = _scraped_quantile(
+        final, "ptpu_serve_token_write_seconds", 0.99)
+    compiles = final.get("ptpu_engine_compiles")
+    # "flat" = a constant absolute bound, NOT a function of n: engine
+    # loop + acceptor + slo/scrape/directory helpers. The slack
+    # absorbs interpreter/jax housekeeping threads that start late.
+    threads_flat = peak["threads"] <= base_threads + 8.0
+    emit({"cell": "soak", "streams": n,
+          "failed_requests": failed, "truncated_streams": truncated,
+          "tokens_identical": bool(identical),
+          "peak_open_connections": peak["conns"],
+          "base_conn_threads": base_threads,
+          "peak_conn_threads": peak["threads"],
+          "p99_token_write_s": p99_write_s,
+          "compiles": compiles, "wall_s": round(wall_s, 3)})
+    ok = bool(failed == 0 and truncated == 0 and identical
+              and peak["conns"] >= 0.9 * n and threads_flat
+              and compiles == 1.0)
+    emit({"cell": "soak_verdict", "ok": ok,
+          "threads_flat": bool(threads_flat)})
+    return ok
+
+
+# -- scenario: fleet_admission — shed at the router, not the replica -------
+
+def scenario_fleet_admission(model, variables, args):
+    """Fleet admission: one replica of a 2-replica fleet is driven
+    into SLO burn by direct overload; the router (fleet admission ON)
+    must shed that replica's shard AT THE FRONT DOOR
+    (`ptpu_router_fleet_sheds_total` > 0, 503 + Retry-After) while
+    the healthy replica's shard is served untouched — 0 failed, 0
+    truncated, and the healthy replica itself sheds nothing."""
+    del model, variables
+    from paddle_tpu.serve.router import Router
+    from paddle_tpu.serve.sse import collect_stream
+
+    rng = np.random.default_rng(13)
+    # a queue-wait objective a 1-batch replica overruns under
+    # concurrent load; the 30s/120s windows LATCH the burn verdict
+    # long enough to measure routing against it (recovery needs the
+    # short window to drain)
+    burn_flags = ("--max-batch-size", "1", "--max-queue-depth", "1024",
+                  "--slo-queue-wait-ms", "100", "--slo-target", "0.5",
+                  "--slo-short-window-s", "30",
+                  "--slo-long-window-s", "120",
+                  "--slo-min-samples", "3", "--slo-interval-s", "0.05")
+    proc_burn, base_burn = _spawn_replica(extra=burn_flags)
+    proc_ok, base_ok = _spawn_replica()
+    router = Router([base_ok, base_burn], scrape_interval_s=0.2,
+                    enable_hedge=False, fleet_admission=True).start()
+    try:
+        # phase 1: concurrent waves straight at the slow replica until
+        # its own monitor reports burning, then wait for the router's
+        # scrape to SEE the verdict
+        def wave():
+            threads = [threading.Thread(target=collect_stream, args=(
+                base_burn,
+                {"prompt": rng.integers(0, _REPLICA_VOCAB - 1,
+                                        8).tolist(),
+                 "max_new_tokens": 16})) for _ in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        burning = 0.0
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0 and not burning:
+            wave()
+            burning = sum(v for k, v in _scrape(base_burn).items()
+                          if k.startswith("ptpu_slo_burning"))
+        seen, seen_s = _wait_for(
+            lambda: bool(_member(router, base_burn).burning), 10.0)
+        emit({"cell": "fleet_admission_burn",
+              "replica_burning": bool(burning),
+              "router_sees_burning": bool(seen),
+              "router_lag_s": round(seen_s, 3)})
+
+        # phase 2: traffic through the router — the burning shard
+        # bounces at the router, the healthy shard serves in full
+        served = shed = other = truncated = 0
+        for _ in range(24):
+            prompt = rng.integers(0, _REPLICA_VOCAB - 1, 6).tolist()
+            out = collect_stream(f"http://127.0.0.1:{router.port}",
+                                 {"prompt": prompt, "max_new_tokens": 4})
+            if out["status"] == 200:
+                served += 1
+                truncated += 0 if out["done"] else 1
+            elif out["status"] == 503 and json.loads(
+                    out["shed_body"]).get("reason") in (
+                    "primary_burn", "fleet_burn"):
+                shed += 1
+            else:
+                other += 1
+        fleet_sheds = sum(
+            router.obs.get("ptpu_router_fleet_sheds_total")
+            .labels(reason=r).value
+            for r in ("primary_burn", "fleet_burn"))
+        ok_vals = _scrape(base_ok)
+        healthy_sheds, _ = _shed_counts(ok_vals)
+        compiles_ok = ok_vals.get("ptpu_engine_compiles")
+    finally:
+        router.stop()
+        for proc in (proc_burn, proc_ok):
+            _terminate(proc)
+
+    ok = bool(seen and fleet_sheds > 0 and shed > 0 and served > 0
+              and truncated == 0 and other == 0
+              and healthy_sheds == 0.0 and compiles_ok == 1.0)
+    emit({"cell": "fleet_admission_verdict", "ok": ok,
+          "served": served, "router_sheds": shed,
+          "fleet_sheds_total": fleet_sheds,
+          "truncated_streams": truncated, "other_failures": other,
+          "healthy_replica_sheds": healthy_sheds,
+          "healthy_compiles": compiles_ok})
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
                              "mixed", "spec", "nbest", "tiered", "tp",
-                             "router", "fleet_chaos", "disagg"])
+                             "router", "fleet_chaos", "disagg",
+                             "soak", "fleet_admission"])
     ap.add_argument("--requests", type=int, default=8)
     ap.add_argument("--new-tokens", type=int, default=24)
     ap.add_argument("--prompt-len", type=int, default=12)
@@ -1859,6 +2135,16 @@ def main():
     ap.add_argument("--slo-deadline-ms", type=float, default=5000.0,
                     help="admitted p99 TTFT must stay under this "
                     "during the overload burst")
+    # soak scenario (high-connection-count asyncio front door)
+    ap.add_argument("--soak-streams", type=int, default=512,
+                    help="concurrent SSE streams the soak holds open "
+                    "against one replica")
+    ap.add_argument("--soak-new-tokens", type=int, default=8,
+                    help="tokens per soak stream (small: the soak "
+                    "measures connection scaling, not decode)")
+    ap.add_argument("--soak-ramp", type=int, default=64,
+                    help="simultaneous CONNECT attempts during the "
+                    "soak ramp (opened streams all stay live)")
     ap.add_argument("--metrics-out", default=None, metavar="FILE",
                     help="write the last verdict engine's Prometheus "
                     "exposition here at end of run")
@@ -1878,7 +2164,9 @@ def main():
                  "tiered": scenario_tiered, "tp": scenario_tp,
                  "router": scenario_router,
                  "fleet_chaos": scenario_fleet_chaos,
-                 "disagg": scenario_disagg}
+                 "disagg": scenario_disagg,
+                 "soak": scenario_soak,
+                 "fleet_admission": scenario_fleet_admission}
     run = (list(scenarios) if args.scenario == "all"
            else [args.scenario])
     oks = {}
