@@ -77,10 +77,14 @@ from paddle_tpu.engine.scheduler import (RUNNING, Request, Scheduler,
                                          StepRow)
 from paddle_tpu.obs.metrics import MetricsRegistry, default_registry
 from paddle_tpu.obs.tracing import RequestTracer
+from paddle_tpu.quant.int8_compute import dequantize_block, quantize_block
 from paddle_tpu.utils.log import serve_event
 
 _COPY_LANES = 8     # COW copies flushed through one fixed-shape call
 _TIER_LANES = 8     # host-tier revivals flushed per fixed-shape write
+# in-device KV compression: a committed block untouched this many steps
+# is cold enough for the proactive quantize sweep (compress_cold)
+_COMPRESS_IDLE_STEPS = 4
 
 
 def _fresh_cx(variables) -> Context:
@@ -169,6 +173,7 @@ class ServeEngine:
                  host_tier_bytes: int = 0,
                  kv_tier_int8: bool = False,
                  tier_spill_dir: Optional[str] = None,
+                 kv_compress_blocks: int = 0,
                  tp_size: int = 1,
                  demote_finished: bool = False):
         self.model = model
@@ -296,12 +301,18 @@ class ServeEngine:
             if loaded:
                 serve_event("tier_warm_start", dir=tier_spill_dir,
                             blocks=loaded)
+        # in-device KV compression (ENGINE.md "In-device KV
+        # compression"): kv_compress_blocks > 0 gives the cache a
+        # parallel int8 block pool cold prefix blocks quantize into at
+        # ~half the bytes — the rung between device-fp and the host
+        # tier. 0 reproduces today's behavior bit for bit.
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
             head_dim=attn.head_dim, dtype=model.dtype,
             enable_prefix_cache=enable_prefix_cache, registry=self.obs,
-            host_tier=self.host_tier, tp_size=self.tp_size,
+            host_tier=self.host_tier,
+            compress_blocks=kv_compress_blocks, tp_size=self.tp_size,
             mesh=self._mesh)
         if self.host_tier is not None:
             # prime the eager kernels tier traffic dispatches — the
@@ -316,6 +327,32 @@ class ServeEngine:
             np.asarray(kp0[0])        # the demote gather's signature
             self.cache.pools[0] = (kp0.at[lanes].set(zero),
                                    vp0.at[lanes].set(zero))
+        if self.cache.compress_enabled:
+            # prime the compressed tier's fixed-lane eager kernels —
+            # the quantize scatter (compress), the dequantize scatter
+            # (promote), and the host-spill gather — with no-op scratch
+            # traffic (fp block 0 <-> int8 slot 0), so the first real
+            # compression/promotion never pays a mid-request compile.
+            # Eager fixed-shape ops like the _TIER_LANES revival path:
+            # no new jit entry points, the step's cache stays at 1.
+            lanes = jnp.zeros((_TIER_LANES,), jnp.int32)
+            kp0, vp0 = self.cache.pools[0]
+            kq0, vq0 = self.cache.qpools[0]
+            ks0, vs0 = self.cache.qscales[0]
+            kq8, ksc = quantize_block(kp0[lanes])
+            vq8, vsc = quantize_block(vp0[lanes])
+            self.cache.qpools[0] = (kq0.at[lanes].set(kq8),
+                                    vq0.at[lanes].set(vq8))
+            self.cache.qscales[0] = (ks0.at[lanes].set(ksc),
+                                     vs0.at[lanes].set(vsc))
+            kq0, vq0 = self.cache.qpools[0]
+            ks0, vs0 = self.cache.qscales[0]
+            kfp = dequantize_block(kq0[lanes], ks0[lanes], kp0.dtype)
+            vfp = dequantize_block(vq0[lanes], vs0[lanes], vp0.dtype)
+            self.cache.pools[0] = (kp0.at[lanes].set(kfp),
+                                   vp0.at[lanes].set(vfp))
+            np.asarray(kq0[0])        # the host-spill gather signatures
+            float(ks0[0])
         self.max_blocks_per_seq = self.cache.blocks_for(self.max_seq_len)
         self.scheduler = Scheduler(
             self.cache, max_batch_size=max_batch_size,
@@ -462,6 +499,13 @@ class ServeEngine:
             "prefix cache")
         self._m_shared = m.gauge(
             "ptpu_kv_shared_blocks", "Blocks with refcount > 1")
+        self._m_compressed = m.gauge(
+            "ptpu_kv_compressed_blocks",
+            "Prefix blocks resident in the device int8 compressed pool")
+        self._m_pool_eff = m.gauge(
+            "ptpu_kv_pool_effective_bytes",
+            "fp-equivalent KV bytes the device holds: the fp pool plus "
+            "every compressed entry at the fp bytes it stands in for")
         self._m_queue_depth = m.gauge(
             "ptpu_sched_queue_depth", "Requests waiting for admission")
         self._m_running = m.gauge(
@@ -615,6 +659,13 @@ class ServeEngine:
         if rows is None:
             return False
         self.steps += 1
+        # publish the coldness clock, then sweep: blocks the plan just
+        # admitted are hot (touched at step_now), so only genuinely
+        # idle prefix content stages quantize lanes for this step's
+        # _flush_compress
+        self.cache.step_now = self.steps
+        if self.cache.compress_enabled:
+            self.cache.compress_cold(_COMPRESS_IDLE_STEPS)
         n_chunks, n_decodes, chunk_tokens, n_drafted = \
             self._step_mixed(rows)
         self.peak_occupancy = max(self.peak_occupancy,
@@ -632,6 +683,8 @@ class ServeEngine:
         self._m_occ.set(self.cache.occupancy())
         self._m_hit.set(self.cache.hit_rate())
         self._m_shared.set(self.cache.shared_blocks)
+        self._m_compressed.set(float(self.cache.compressed_resident))
+        self._m_pool_eff.set(float(self.cache.effective_pool_bytes()))
         self._m_queue_depth.set(self.scheduler.queue_depth)
         self._m_running.set(len(self.scheduler.running))
         self._m_decode_rows.set(n_decodes)
@@ -690,17 +743,70 @@ class ServeEngine:
                     kp.at[blocks].set(jnp.asarray(kd, kp.dtype)),
                     vp.at[blocks].set(jnp.asarray(vd, vp.dtype)))
 
+    def _flush_compress(self) -> None:
+        """Quantize staged cold fp blocks into the int8 pool — FIRST
+        among the pre-step flushes, so the quantize lanes read every
+        src block's content before promotions, host loads, or COW
+        copies can overwrite it. Fixed _TIER_LANES-wide eager
+        gather-quantize-scatter per batch (pad lanes read fp scratch
+        block 0 and write int8 scratch slot 0), primed at construction:
+        no new jit entry points, the step's compile cache stays at 1."""
+        jobs = self.cache.drain_compress()
+        for i in range(0, len(jobs), _TIER_LANES):
+            batch = jobs[i:i + _TIER_LANES]
+            src = np.zeros((_TIER_LANES,), np.int32)   # fp blocks
+            dst = np.zeros((_TIER_LANES,), np.int32)   # int8 slots
+            for j, (b, s) in enumerate(batch):
+                src[j], dst[j] = b, s
+            bsrc, bdst = jnp.asarray(src), jnp.asarray(dst)
+            for li, (kp, vp) in enumerate(self.cache.pools):
+                kq, vq = self.cache.qpools[li]
+                ks, vs = self.cache.qscales[li]
+                kq8, ksc = quantize_block(kp[bsrc])
+                vq8, vsc = quantize_block(vp[bsrc])
+                self.cache.qpools[li] = (kq.at[bdst].set(kq8),
+                                         vq.at[bdst].set(vq8))
+                self.cache.qscales[li] = (ks.at[bdst].set(ksc),
+                                          vs.at[bdst].set(vsc))
+
+    def _flush_promote(self) -> None:
+        """Dequantize staged compressed-tier hits into their claimed fp
+        blocks — after _flush_compress (a promotion may read a slot the
+        same plan just filled) and BEFORE host loads, COW copies, and
+        the step read: the same staging contract as tier revivals. Pad
+        lanes read int8 scratch slot 0 and write fp scratch block 0."""
+        jobs = self.cache.drain_promotes()
+        for i in range(0, len(jobs), _TIER_LANES):
+            batch = jobs[i:i + _TIER_LANES]
+            src = np.zeros((_TIER_LANES,), np.int32)   # int8 slots
+            dst = np.zeros((_TIER_LANES,), np.int32)   # fp blocks
+            for j, (b, s) in enumerate(batch):
+                dst[j], src[j] = b, s
+            bsrc, bdst = jnp.asarray(src), jnp.asarray(dst)
+            for li, (kp, vp) in enumerate(self.cache.pools):
+                kq, vq = self.cache.qpools[li]
+                ks, vs = self.cache.qscales[li]
+                kfp = dequantize_block(kq[bsrc], ks[bsrc], kp.dtype)
+                vfp = dequantize_block(vq[bsrc], vs[bsrc], vp.dtype)
+                self.cache.pools[li] = (kp.at[bdst].set(kfp),
+                                        vp.at[bdst].set(vfp))
+
     def kv_prefix_directory(self, limit: int = 512) -> List[dict]:
         """This replica's fleet-directory advertisement: the warm
         prefixes it can serve without re-prefill, as
-        {len, digest, tier} rows (device = prefix-index entries, host =
-        tier entries). Digests are crc32 over little-endian u32 token
+        {len, digest, tier} rows (device = prefix-index entries,
+        device_int8 = in-device compressed entries, host = tier
+        entries). Digests are crc32 over little-endian u32 token
         ids — the same encoding the router's prefix_shard hashes.
         Engine-loop thread only (reads the unlocked prefix index); the
         serve front-end snapshots it between steps for /kvprefixes."""
         out = [{"len": len(key), "digest": prefix_digest(key),
                 "tier": "device"}
                for key in self.cache.prefix_keys(limit)]
+        if self.cache.compress_enabled:
+            out.extend({"len": len(key), "digest": prefix_digest(key),
+                        "tier": "device_int8"}
+                       for key in self.cache.compressed_keys(limit))
         if self.host_tier is not None:
             out.extend({"len": ln, "digest": dg, "tier": "host"}
                        for ln, dg in self.host_tier.advertised(limit))
@@ -771,6 +877,8 @@ class ServeEngine:
         speculative rows gather one hidden state per window position
         for verification; every other row repeats its single real
         index across the columns."""
+        self._flush_compress()
+        self._flush_promote()
         self._flush_tier_loads()
         self._flush_cow()
         t_flat, tq, nt = self.flat_tokens, self.tile_q, self.num_tiles

@@ -180,6 +180,36 @@ class HostKVTier:
         self._g_entries.set(float(count))
         return True
 
+    def put_device_int8(self, key: tuple, qlayers: list, dtype,
+                        reason: str = "evict") -> bool:
+        """Demote-to-host FAST PATH for a block already int8 on device
+        (PagedKVCache's compressed tier spilling its coldest entry):
+        per-layer (kq, ks, vq, vs) payloads arrive quantized, and the
+        content round-trips in ONE quant step total, never two. An
+        int8-mode tier stores them verbatim — revival dequantizes with
+        the original device scales, byte-identical to revival straight
+        from the int8 pool. An fp-mode tier stores the exact
+        dequantization: dequantize is deterministic, so no second
+        quantization ever happens either way."""
+        dtype = np.dtype(dtype)
+        blobs = []
+        nbytes = 0
+        for kq, ks, vq, vs in qlayers:
+            kq = np.asarray(kq)
+            vq = np.asarray(vq)
+            if self.int8:
+                blobs.append((kq, float(ks), vq, float(vs), dtype))
+                nbytes += kq.nbytes + vq.nbytes + 16
+            else:
+                k = dequantize_host_int8(kq, float(ks), dtype)
+                v = dequantize_host_int8(vq, float(vs), dtype)
+                blobs.append((k, v))
+                nbytes += k.nbytes + v.nbytes
+        if not self._insert_raw(key, blobs, nbytes):
+            return False
+        self._c_demoted.labels(reason=reason).inc()
+        return True
+
     # -- revival ----------------------------------------------------------
     def get(self, key: tuple) -> Optional[BlockLayers]:
         """Per-layer (k, v) float arrays for a stored block (LRU touch),

@@ -34,6 +34,23 @@ when `_pop_free` hands the block out for fresh content; frees append
 to the right and pops take from the left, so the longest-freed cached
 content is recycled first (FIFO ~ LRU here).
 
+In-device compressed tier (ENGINE.md "In-device KV compression"): with
+`compress_blocks > 0` the cache also owns a parallel int8 block pool
+plus per-block k/v scales (`qpools`/`qscales`, slot 0 scratch like
+block 0). Cold committed prefix blocks QUANTIZE INTO IT at ~half the
+bytes — proactively while still fp-resident (compress_cold: the fp
+copy and index entry stay, so fp hits remain byte-exact), and as the
+first rung of the demotion ladder when the pool recycles a cached-free
+block or a sequence preempts: device-fp -> device-int8 -> host tier ->
+gone. A prefix hit against a compressed entry claims a fresh fp block
+and stages a dequantize PROMOTION the engine flushes before the step
+reads it — like a host-tier revival, except the payload never leaves
+the device. Everything here is host-side bookkeeping; the actual
+quantize/dequantize run as the engine's fixed-lane eager scatters
+(primed at construction, jit cache stays at exactly 1), and a spilled
+compressed entry ships its int8 payload + scales straight into the
+host tier without a second quantization.
+
 Host/device split: this class is the HOST-side allocator + bookkeeping
 (free list, refcounts, per-sequence tables/lengths/tokens, prefix
 index). The device-side pools are jnp arrays held in `self.pools` and
@@ -50,8 +67,8 @@ sequence.
 
 from __future__ import annotations
 
-from collections import deque
-from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Tuple
+from collections import OrderedDict, deque
+from typing import TYPE_CHECKING, Dict, List, Optional, Sequence, Set, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -79,9 +96,12 @@ class PagedKVCache:
                  enable_prefix_cache: bool = True,
                  registry: Optional[MetricsRegistry] = None,
                  host_tier: Optional["HostKVTier"] = None,
+                 compress_blocks: int = 0,
                  tp_size: int = 1, mesh=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
+        if compress_blocks < 0:
+            raise ValueError(f"compress_blocks {compress_blocks} < 0")
         if tp_size < 1:
             raise ValueError(f"tp_size {tp_size} < 1")
         if num_kv_heads % tp_size != 0:
@@ -111,6 +131,50 @@ class PagedKVCache:
             ns = NamedSharding(mesh, P(None, None, "tp", None))
             self.pools = [(jax.device_put(kp, ns), jax.device_put(vp, ns))
                           for kp, vp in self.pools]
+        # optional in-device compressed tier: a parallel int8 block pool
+        # (+ per-block k/v scales) cold prefix content quantizes into at
+        # ~half the bytes. Slot 0 is scratch (the fixed-lane flushes pad
+        # with it), mirroring fp block 0. Like `pools`, the arrays are
+        # updated FUNCTIONALLY by the engine's eager lane scatters.
+        self.compress_blocks = int(compress_blocks)
+        self._compress_on = self.compress_blocks > 0 and enable_prefix_cache
+        self.qpools: List[Tuple[jnp.ndarray, jnp.ndarray]] = []
+        self.qscales: List[Tuple[jnp.ndarray, jnp.ndarray]] = []
+        if self._compress_on:
+            qshape = (self.compress_blocks + 1, block_size,
+                      num_kv_heads, head_dim)
+            self.qpools = [(jnp.zeros(qshape, jnp.int8),
+                            jnp.zeros(qshape, jnp.int8))
+                           for _ in range(num_layers)]
+            self.qscales = [(jnp.ones((self.compress_blocks + 1,),
+                                      jnp.float32),
+                             jnp.ones((self.compress_blocks + 1,),
+                                      jnp.float32))
+                            for _ in range(num_layers)]
+            if mesh is not None and tp_size > 1:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                qns = NamedSharding(mesh, P(None, None, "tp", None))
+                self.qpools = [(jax.device_put(kq, qns),
+                                jax.device_put(vq, qns))
+                               for kq, vq in self.qpools]
+        # compressed-tier bookkeeping (host-side): slot free list,
+        # content-keyed LRU index (OrderedDict end = hottest), reverse
+        # map, staged fixed-lane traffic, and the last-hit clock the
+        # deterministic coldness policy orders by (the ENGINE publishes
+        # step_now each step).
+        self._cfree = deque(range(1, self.compress_blocks + 1))
+        self._cindex: "OrderedDict[tuple, int]" = OrderedDict()
+        self._cslot_key: Dict[int, tuple] = {}
+        self._pending_compress: List[Tuple[int, int]] = []  # (fp blk, slot)
+        self._pending_promotes: List[Tuple[int, int]] = []  # (fp blk, slot)
+        self._promote_slots: Set[int] = set()
+        self._last_hit: Dict[int, int] = {}           # block -> step
+        self.step_now = 0
+        self.compressed_total = 0         # blocks quantized in-device
+        self.promoted_total = 0           # compressed blocks re-inflated
+        self.compress_spills = 0          # cslot evictions (-> host/gone)
+        self.compress_hit_tokens = 0      # prompt tokens served int8
         # block 0 reserved for padded/dummy rows — never handed out
         self._free = deque(range(1, num_blocks))
         self._tables: Dict[int, List[int]] = {}
@@ -161,6 +225,12 @@ class PagedKVCache:
         self._c_hit_toks = reg.counter(
             "ptpu_kv_hit_tokens_total",
             "Prompt tokens served from the prefix cache")
+        self._c_compress = reg.counter(
+            "ptpu_kv_compress_total",
+            "Cold prefix blocks quantized into the device int8 pool")
+        self._c_promote = reg.counter(
+            "ptpu_kv_promote_total",
+            "Compressed blocks dequantized back into fp on a prefix hit")
 
     # -- capacity ---------------------------------------------------------
     def pool_shape(self, tp_size: Optional[int] = None) -> Tuple[int, ...]:
@@ -235,19 +305,114 @@ class PagedKVCache:
             del self._index[key]
             self.cached_free_evictions += 1
             self._c_evict.inc()
+        self._last_hit.pop(block, None)
         return block
 
     def _demote_block(self, block: int, key: tuple, reason: str) -> bool:
-        """device_get one committed block's KV (every layer) into the
-        host tier under its content key. No-op without a tier or when
-        the tier already holds the key (a revived-but-unflushed block
-        would otherwise read back garbage — the tier copy is the truth
-        until the staged load lands)."""
+        """Ship one committed block's KV one rung down the demotion
+        ladder — device-fp -> device-int8 -> host tier -> gone — under
+        its content key. The int8 rung stages a fixed-lane quantize the
+        engine flushes before anything overwrites the src block (the
+        payload never leaves the device); the host rung is a device_get
+        into the tier. reason="finish" skips the int8 rung: finish
+        demotion feeds the fleet KV-transfer plane (serve/kvxfer.py
+        GET /kvblocks), which serves from the HOST tier. A no-op when a
+        lower rung already holds the key — that copy is the truth (the
+        key IS the content, so it can never be stale), and re-encoding
+        a revived-but-unflushed block would read back garbage."""
+        if self._compress_on and reason != "finish":
+            if key in self._cindex:
+                return False          # already resident one rung down
+            slot = self._take_cslot()
+            if slot is not None:
+                self._stage_compress(block, key, slot)
+                return True
         if self.host_tier is None or self.host_tier.contains(key):
             return False
         layers = [(np.asarray(kp[block]), np.asarray(vp[block]))
                   for kp, vp in self.pools]
         return self.host_tier.put(key, layers, reason=reason)
+
+    # -- in-device compressed tier ----------------------------------------
+    def _stage_compress(self, block: int, key: tuple, slot: int) -> None:
+        """Queue one fp block's quantize into int8 slot `slot`. The
+        payload is READ at flush time, which is safe against every
+        same-plan writer: promotions, host loads, and COW copies all
+        flush after compressions, and prefill/decode scatters land in
+        the step after that."""
+        self._pending_compress.append((block, slot))
+        self._cindex[key] = slot           # inserted hottest (end)
+        self._cslot_key[slot] = key
+        self.compressed_total += 1
+        self._c_compress.inc()
+
+    def _take_cslot(self) -> Optional[int]:
+        """A free int8 slot — or the coldest evictable compressed
+        entry's slot, after spilling that entry one rung further down.
+        Slots with in-flight lane traffic are not evictable: a
+        pending-compress dst holds no payload yet (spilling it would
+        read scratch garbage) and a pending-promote src is about to be
+        read by the flush. Returns None when nothing can move; the
+        caller falls through to the host rung."""
+        if self._cfree:
+            return self._cfree.popleft()
+        busy = {s for _, s in self._pending_compress}
+        busy |= self._promote_slots
+        for key, slot in self._cindex.items():     # coldest first
+            if slot in busy:
+                continue
+            self._spill_cslot(key, slot)
+            del self._cindex[key]
+            del self._cslot_key[slot]
+            return slot
+        return None
+
+    def _spill_cslot(self, key: tuple, slot: int) -> None:
+        """Demote-to-host FAST PATH for an evicted compressed entry:
+        the int8 payload + scales ship straight into the host tier —
+        one quant step total, never a dequant->requant round trip. An
+        int8-mode tier stores the device blobs verbatim (revival
+        dequantizes with the original scales); an fp-mode tier stores
+        the exact dequantization, which adds no second quant step."""
+        self.compress_spills += 1
+        if self.host_tier is None or self.host_tier.contains(key):
+            return
+        qlayers = []
+        for li, (kq, vq) in enumerate(self.qpools):
+            ks, vs = self.qscales[li]
+            qlayers.append((np.asarray(kq[slot]), float(ks[slot]),
+                            np.asarray(vq[slot]), float(vs[slot])))
+        self.host_tier.put_device_int8(key, qlayers, self.dtype,
+                                       reason="evict")
+
+    def compress_cold(self, idle_steps: int = 4,
+                      max_blocks: Optional[int] = None) -> int:
+        """Proactive cold sweep (engine-driven, once per step):
+        quantize the coldest committed prefix blocks — cached-free AND
+        refcount-shared — into FREE int8 slots before pool pressure
+        would evict them. Coldness is deterministic LRU by last-hit
+        step; a block must have sat untouched >= `idle_steps`. The fp
+        copy and its index entry STAY, so fp hits remain byte-exact and
+        compressing a block that is still referenced is safe (committed
+        full blocks are content-immutable: the key IS the content).
+        The proactive path only fills free slots — it never spills a
+        warmer compressed entry to make room; forced demotions do that.
+        Returns blocks staged."""
+        if not self._compress_on or not self._cfree:
+            return 0
+        cands = sorted(
+            (self._last_hit.get(b, 0), b)
+            for b, key in self._key_of.items()
+            if key not in self._cindex
+            and self.step_now - self._last_hit.get(b, 0) >= idle_steps)
+        staged = 0
+        for _, b in cands:
+            if not self._cfree or (max_blocks is not None
+                                   and staged >= max_blocks):
+                break
+            self._stage_compress(b, self._key_of[b], self._cfree.popleft())
+            staged += 1
+        return staged
 
     def demote_sequence(self, seq_id: int, reason: str = "preempt") -> int:
         """Copy a live sequence's committed full blocks out to the host
@@ -257,8 +422,12 @@ class PagedKVCache:
         linear copy). A prefill-phase engine also calls it at request
         FINISH (reason="finish") so a decode replica can pull the
         finished prefix over the fleet KV-transfer plane
-        (serve/kvxfer.py). Returns blocks demoted."""
-        if self.host_tier is None or not self.enable_prefix_cache:
+        (serve/kvxfer.py). Returns blocks demoted. With the in-device
+        compressed tier enabled this works without a host tier too —
+        preempted blocks land one rung down in int8 (the cheapest
+        revival) instead of being recompute-only."""
+        if (self.host_tier is None and not self._compress_on) \
+                or not self.enable_prefix_cache:
             return 0
         table = self._tables.get(seq_id)
         if table is None:
@@ -318,12 +487,24 @@ class PagedKVCache:
         n = len(tokens)
         bs = self.block_size
         matched = self._match_prefix(tokens)
-        # walk PAST the device match into the host tier: every hit is
-        # fetched now (the payload is pinned here — a later demotion's
-        # LRU eviction between admission and flush can't revoke it)
+        # walk PAST the device-fp match into the compressed tier: each
+        # hit will claim a fresh fp block and stage a fixed-lane
+        # dequantize promotion the engine flushes before the step (and
+        # ahead of COW) reads it
+        promo: List[Tuple[tuple, int]] = []
+        if self._compress_on:
+            for end in range((len(matched) + 1) * bs, n + 1, bs):
+                slot = self._cindex.get(tuple(tokens[:end]))
+                if slot is None:
+                    break
+                promo.append((tuple(tokens[:end]), slot))
+        # ... and past THAT into the host tier: every hit is fetched
+        # now (the payload is pinned here — a later demotion's LRU
+        # eviction between admission and flush can't revoke it)
         host_loads: List[Tuple[tuple, list]] = []
         if self.host_tier is not None and self.enable_prefix_cache:
-            for end in range((len(matched) + 1) * bs, n + 1, bs):
+            for end in range((len(matched) + len(promo) + 1) * bs,
+                             n + 1, bs):
                 layers = self.host_tier.get(tuple(tokens[:end]))
                 if layers is None:
                     break
@@ -341,6 +522,27 @@ class PagedKVCache:
                 self._refs[b] = 1
                 self.cached_free_revivals += 1
                 self._c_revive.inc()
+            self._last_hit[b] = self.step_now
+        # compressed hits claim fresh fp blocks and stage dequantize
+        # promotions. Pin every promo slot FIRST: the _pop_free calls
+        # below can themselves demote dying cached-free entries into
+        # the int8 pool, and a full pool would otherwise evict (spill)
+        # the very slot we are about to promote from.
+        promo_blocks: List[int] = []
+        if promo:
+            self._promote_slots.update(s for _, s in promo)
+            for key, slot in promo:
+                b = self._pop_free()
+                self._refs[b] = 1
+                promo_blocks.append(b)
+                self._pending_promotes.append((b, slot))
+                self._cindex.move_to_end(key)        # LRU touch: hottest
+                self._last_hit[b] = self.step_now
+                if key not in self._index and b not in self._key_of:
+                    self._index[key] = b
+                    self._key_of[b] = key
+                self.promoted_total += 1
+                self._c_promote.inc()
         # host-tier hits claim fresh device blocks and stage their DMA;
         # the key registers first-wins so later prompts can share the
         # block as soon as the engine flushes the load
@@ -353,16 +555,24 @@ class PagedKVCache:
             if key not in self._index and b not in self._key_of:
                 self._index[key] = b
                 self._key_of[b] = key
-        fresh = [self._pop_free() for _ in range(need - len(host_blocks))]
+        fresh = [self._pop_free()
+                 for _ in range(need - len(promo_blocks) - len(host_blocks))]
         for b in fresh:
             self._refs[b] = 1
-        self._tables[seq_id] = matched + host_blocks + fresh
+            self._last_hit[b] = self.step_now
+        self._tables[seq_id] = matched + promo_blocks + host_blocks + fresh
         self._lens[seq_id] = n
         self._tokens[seq_id] = list(tokens)
-        cached = min((len(matched) + len(host_blocks)) * bs, n - 1)
+        cached = min((len(matched) + len(promo_blocks) + len(host_blocks))
+                     * bs, n - 1)
         self._committed[seq_id] = cached
+        if promo_blocks:
+            self.compress_hit_tokens += max(
+                0, min((len(matched) + len(promo_blocks)) * bs, cached)
+                - len(matched) * bs)
         if host_blocks:
-            tier_toks = max(0, cached - len(matched) * bs)
+            tier_toks = max(0, cached - (len(matched) + len(promo_blocks))
+                            * bs)
             self.tier_revivals += len(host_blocks)
             self.tier_hit_tokens += tier_toks
             self.host_tier.note_revived(len(host_blocks), tier_toks)
@@ -409,6 +619,22 @@ class PagedKVCache:
         BEFORE draining COW copies — a just-revived block can be the
         src of a same-plan copy-on-write."""
         out, self._pending_host_loads = self._pending_host_loads, []
+        return out
+
+    def drain_compress(self) -> List[Tuple[int, int]]:
+        """Staged (fp block, int8 slot) quantizations. The engine MUST
+        flush these FIRST — before promotions, host loads, and COW
+        copies — so the quantize lanes read every src block's content
+        ahead of any same-plan writer reusing it."""
+        out, self._pending_compress = self._pending_compress, []
+        return out
+
+    def drain_promotes(self) -> List[Tuple[int, int]]:
+        """Staged (fp block, int8 slot) dequantize promotions, flushed
+        AFTER compressions (a promo may read a slot the same plan just
+        filled) and BEFORE host loads / COW copies / the step read."""
+        out, self._pending_promotes = self._pending_promotes, []
+        self._promote_slots = set()
         return out
 
     def commit_prefill(self, seq_id: int, upto: int) -> None:
@@ -479,6 +705,7 @@ class PagedKVCache:
         for _ in range(new_need):
             block = self._pop_free()
             self._refs[block] = 1
+            self._last_hit[block] = self.step_now
             table.append(block)
         return [table[(pos + j) // bs] * bs + (pos + j) % bs
                 for j in range(count)]
@@ -535,6 +762,9 @@ class PagedKVCache:
             if self._refs[b] == 0:
                 del self._refs[b]
                 self._free.append(b)
+                # the block was in live use until this very step — its
+                # cached-free coldness clock starts NOW
+                self._last_hit[b] = self.step_now
                 freed += 1
                 freed_set.add(b)
         if freed_set and self._pending_copies:
@@ -554,6 +784,23 @@ class PagedKVCache:
                     (b, la) for b, la in self._pending_host_loads
                     if b not in freed_set]
                 for b in stale:
+                    key = self._key_of.pop(b, None)
+                    if key is not None and self._index.get(key) == b:
+                        del self._index[key]
+        if freed_set and self._pending_promotes:
+            # cancel-mid-promotion (mirror of the host-load cancel):
+            # a freed dst block may be re-issued immediately, and a
+            # stale dequantize flushing later would clobber the new
+            # owner's KV. The compressed entry still holds the payload;
+            # a re-request promotes it onto new blocks.
+            stale_p = [b for b, _ in self._pending_promotes
+                       if b in freed_set]
+            if stale_p:
+                self._pending_promotes = [
+                    (b, s) for b, s in self._pending_promotes
+                    if b not in freed_set]
+                self._promote_slots = {s for _, s in self._pending_promotes}
+                for b in stale_p:
                     key = self._key_of.pop(b, None)
                     if key is not None and self._index.get(key) == b:
                         del self._index[key]
@@ -588,6 +835,35 @@ class PagedKVCache:
         keys = list(self._index.keys())
         return keys[-limit:] if limit and len(keys) > limit else keys
 
+    def compressed_keys(self, limit: int = 512) -> List[tuple]:
+        """Most recently touched compressed-tier keys (hottest last) —
+        advertised as the `device_int8` rung of the fleet prefix
+        directory, between device-fp and host. Engine-loop thread
+        only."""
+        keys = list(self._cindex.keys())
+        return keys[-limit:] if limit and len(keys) > limit else keys
+
+    @property
+    def compress_enabled(self) -> bool:
+        """Whether the in-device int8 tier is active (budget > 0 and
+        prefix caching on) — the scheduler's victim costing and the
+        engine's directory advertisement branch on this."""
+        return self._compress_on
+
+    @property
+    def compressed_resident(self) -> int:
+        return len(self._cindex)
+
+    def effective_pool_bytes(self) -> int:
+        """fp-equivalent bytes of KV the device currently holds: the
+        fp pool plus every RESIDENT compressed entry counted at the fp
+        bytes it stands in for. Reaches (num_blocks-1 + compress_blocks)
+        x block-bytes when the int8 pool is full — the ~2x-effective-
+        pool headline, sampled into ptpu_kv_pool_effective_bytes."""
+        blk = (2 * self.block_size * self.num_kv_heads * self.head_dim
+               * np.dtype(self.dtype).itemsize * len(self.pools))
+        return (self.num_blocks - 1 + len(self._cindex)) * blk
+
     # -- observability ----------------------------------------------------
     def hit_rate(self) -> float:
         """Fraction of all prompt tokens served from the prefix cache."""
@@ -605,6 +881,12 @@ class PagedKVCache:
             "used_blocks": self.used_blocks,
             "occupancy": round(self.occupancy(), 4),
         }
+        if self._compress_on:
+            out["compressed_blocks"] = len(self._cindex)
+            out["compress_total"] = self.compressed_total
+            out["promote_total"] = self.promoted_total
+            out["compress_spills"] = self.compress_spills
+            out["compress_hit_tokens"] = self.compress_hit_tokens
         if self.host_tier is not None:
             out["tier_revivals"] = self.tier_revivals
             out["tier_hit_tokens"] = self.tier_hit_tokens
@@ -615,6 +897,8 @@ class PagedKVCache:
         self.hit_tokens = self.prompt_tokens = self.cow_copies = 0
         self.cached_free_evictions = self.cached_free_revivals = 0
         self.tier_revivals = self.tier_hit_tokens = 0
+        self.compressed_total = self.promoted_total = 0
+        self.compress_spills = self.compress_hit_tokens = 0
 
     def assert_quiesced(self) -> None:
         """Leak check: with no live sequences every refcount must be
@@ -629,6 +913,19 @@ class PagedKVCache:
             raise RuntimeError(
                 f"{len(self._pending_host_loads)} host-tier loads never "
                 "flushed")
+        if self._pending_compress:
+            raise RuntimeError(
+                f"{len(self._pending_compress)} compress lanes never "
+                "flushed")
+        if self._pending_promotes:
+            raise RuntimeError(
+                f"{len(self._pending_promotes)} promote lanes never "
+                "flushed")
+        if self._compress_on and \
+                len(self._cfree) + len(self._cindex) != self.compress_blocks:
+            raise RuntimeError(
+                f"compressed-slot leak: {len(self._cfree)} free + "
+                f"{len(self._cindex)} resident != {self.compress_blocks}")
         if len(self._free) != self.num_blocks - 1:
             raise RuntimeError(
                 f"free list {len(self._free)} != {self.num_blocks - 1}")

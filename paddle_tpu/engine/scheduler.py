@@ -325,17 +325,23 @@ class Scheduler:
 
     def _preempt_cost(self, req: Request) -> float:
         """Modeled cost of evicting `req` and bringing it back. Without
-        a host tier every committed token re-prefills, and attention
+        any lower tier every committed token re-prefills, and attention
         over the growing context makes that superlinear: ~n^2. With a
-        tier, committed FULL blocks swap out and revive by DMA (linear
-        in bytes ~ n) and only the uncommitted tail re-prefills
+        host tier, committed FULL blocks swap out and revive by DMA
+        (linear in bytes ~ n) and only the uncommitted tail re-prefills
         (~tail^2) — which is why long-context victims flip from worst
-        choice to best under a tier."""
+        choice to best under a tier. The in-device int8 rung is
+        CHEAPER still: demotion and promotion are on-device lane
+        scatters (no host DMA on either side), so full blocks cost a
+        fraction of the host rung's weight."""
         n = len(req.tokens)
-        if self.cache.host_tier is None:
+        if self.cache.host_tier is None \
+                and not self.cache.compress_enabled:
             return float(n * n)
         full = (n // self.cache.block_size) * self.cache.block_size
         tail = n - full
+        if self.cache.compress_enabled:
+            return float(full * 0.25 + tail * tail)
         return float(full + tail * tail)
 
     def _pick_victim(self, keep: Request) -> Optional[Request]:
@@ -344,11 +350,13 @@ class Scheduler:
         re-prefill, so it should land on the request that can best
         absorb it. Without deadlines every slack is +inf and the choice
         degrades to the original deterministic rule: last admitted.
-        With a host tier attached, equal-slack candidates are split by
-        the swap-vs-recompute cost model instead (cheapest round-trip
-        loses its blocks); without one the legacy rule is bit-exact.
-        None when nothing else is left to evict."""
-        if self.cache.host_tier is None:
+        With a host tier or the in-device compressed tier attached,
+        equal-slack candidates are split by the swap-vs-recompute cost
+        model instead (cheapest round-trip loses its blocks); with
+        neither the legacy rule is bit-exact. None when nothing else is
+        left to evict."""
+        if self.cache.host_tier is None \
+                and not self.cache.compress_enabled:
             best: Optional[Request] = None
             for r in self.running:      # later index wins ties (stable max)
                 if r is not keep and (best is None

@@ -267,6 +267,43 @@ def freeze_int8(module: Module, variables: Variables, calib_batches=None
 # but pure numpy: demotion/revival are host-RAM traffic and must not
 # touch the device (the engine's jit cache stays at exactly 1).
 
+#: abs-max floor for KV block scales, device side. Matches the
+#: quantized-collective floor (parallel/serve_collective.py): an
+#: all-zeros block gets a tiny positive scale so 0 quantizes to exactly
+#: 0 and dequantizes to exactly 0. The host helpers floor at 1e-12 for
+#: historical reasons; both floors only engage below any representable
+#: KV magnitude, so host and device scales agree bit-for-bit on real
+#: content (tests/test_kvcompress.py pins it) and the three encodings —
+#: host tier, wire, device pool — stay interchangeable.
+KV_SCALE_FLOOR = 1e-30
+
+
+def quantize_block(x):
+    """jit-safe per-block symmetric abs-max int8 quantization on
+    DEVICE: reduces over the trailing (block_size, heads, head_dim)
+    axes, so a 3-D single block yields a scalar scale and a 4-D
+    [lanes, ...] batch (the engine's fixed-lane compress scatter)
+    yields one scale per lane. Same scheme as quantize_host_int8 —
+    scale = max|x| per block, q = round(x / scale * 127) — so a block
+    quantized on device and one quantized on host carry identical
+    payloads and interchange freely across the tier/wire/device
+    encodings. Returns (int8 array, f32 scales of shape x.shape[:-3])."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=(-3, -2, -1)),
+                        jnp.float32(KV_SCALE_FLOOR))
+    q = jnp.clip(jnp.round(xf / scale[..., None, None, None] * QMAX),
+                 -QMAX, QMAX)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_block(q, scale, dtype):
+    """Inverse of quantize_block (device side): max abs error is
+    scale / QMAX per element — one quantization step, the same bound
+    the host tier documents. `scale` broadcasts over the trailing
+    three axes (scalar for one block, [lanes] for a lane batch)."""
+    s = jnp.asarray(scale, jnp.float32)[..., None, None, None]
+    return (q.astype(jnp.float32) * (s / QMAX)).astype(dtype)
+
 def quantize_host_int8(x: np.ndarray) -> Tuple[np.ndarray, float]:
     """Per-tensor symmetric abs-max int8 quantization on the host.
     Returns (int8 array, float scale) with scale = max|x| (dequant is

@@ -263,8 +263,10 @@ class ServeFrontend:
         self._open_streams = 0               # guarded-by: self._lock
         # fleet prefix directory advertisement (/kvprefixes): the
         # engine loop snapshots {len, digest, tier} rows from the
-        # prefix index + host tier every _DIR_INTERVAL_S; handler
-        # threads serve the snapshot (never touch the engine)
+        # prefix index + device int8 compressed pool + host tier
+        # (tier in device|device_int8|host, hottest first) every
+        # _DIR_INTERVAL_S; handler threads serve the snapshot (never
+        # touch the engine)
         self._directory: List[dict] = []     # guarded-by: self._lock
         self._dir_next = 0.0                 # engine-loop thread only
         # /debug snapshot: refreshed on the engine loop at the same

@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kv-tier-int8", action="store_true",
                    help="store host-tier blocks int8-quantized "
                         "(roughly doubles the tier's effective budget)")
+    p.add_argument("--kv-compress-blocks", type=int, default=0,
+                   help="device int8 KV compression pool size in blocks "
+                        "(0 disables): cold cached-free / idle shared "
+                        "prefix blocks are quantized in place on device "
+                        "and promoted back to fp on a prefix hit — "
+                        "engine/paged_cache.py")
     p.add_argument("--tier-spill-dir", default=None,
                    help="warm-restart directory for the host KV tier: "
                         "the tier spills here when a drain completes "
@@ -173,6 +179,7 @@ def build_frontend(a: argparse.Namespace):
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
             kv_tier_int8=a.kv_tier_int8,
+            kv_compress_blocks=a.kv_compress_blocks,
             tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size,
             demote_finished=(a.phase == "prefill"))
     else:
@@ -194,6 +201,7 @@ def build_frontend(a: argparse.Namespace):
             spec_k=a.spec_k, registry=registry,
             host_tier_bytes=a.host_tier_bytes,
             kv_tier_int8=a.kv_tier_int8,
+            kv_compress_blocks=a.kv_compress_blocks,
             tier_spill_dir=a.tier_spill_dir, tp_size=a.tp_size,
             demote_finished=(a.phase == "prefill"))
     slo = SLOMonitor(

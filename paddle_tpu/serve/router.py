@@ -190,9 +190,11 @@ def prefix_digest(tokens: Sequence[int]) -> str:
     return format(zlib.crc32(raw), "08x")
 
 
-# directory tier ranking: a device-resident prefix serves with zero
-# copies, a host-tier one needs a DMA revival, anything else re-prefills
-_TIER_RANK = {"device": 1, "host": 0}
+# directory tier ranking: a device-resident fp prefix serves with zero
+# copies, a device-int8 one needs only an on-device dequantize promotion
+# (no DMA), a host-tier one needs a DMA revival, anything else
+# re-prefills
+_TIER_RANK = {"device": 2, "device_int8": 1, "host": 0}
 
 # breaker state as a gauge level (ptpu_router_breaker_state)
 _BREAKER_LEVEL = {"closed": 0.0, "half_open": 1.0, "open": 2.0}

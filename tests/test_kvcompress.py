@@ -1,0 +1,307 @@
+"""In-device int8 KV compression tests (engine/paged_cache.py qpools).
+
+The tentpole guarantees under test:
+
+- QUANT IS ONE STEP: device quantize_block/dequantize_block round-trips
+  within scale/127 per element, and the device scales are bit-equal to
+  the host-side quantize_host_int8 scales on real KV content (so a
+  block that compresses on device and spills to an int8 host tier pays
+  ONE quant step total, never two).
+- COMPRESSION IS A COPY, NOT A MOVE: compressing a cold block leaves
+  the fp copy, its index entry, and its refcounts untouched — fp hits
+  stay byte-exact even on refcount-shared blocks; the int8 copy only
+  pays off after the fp copy is evicted.
+- PROMOTION IS INVISIBLE: a prefix hit on a compressed-only block
+  dequantizes back into an fp block ahead of the step, the jit cache
+  stays at ONE compiled step, and a tight pool that preempts completes
+  every request.
+- ZERO IS OFF: kv_compress_blocks=0 reproduces the uncompressed
+  engine's behavior bit-for-bit (outputs AND stats).
+- THE FLEET AGREES: the directory ranks device > device_int8 > host,
+  and the engine advertises device_int8 rows for compressed prefixes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.engine import HostKVTier, PagedKVCache, ServeEngine
+from paddle_tpu.engine.kvtier import prefix_digest
+from paddle_tpu.models.transformer import CausalLM
+from paddle_tpu.obs.metrics import MetricsRegistry
+from paddle_tpu.quant.int8_compute import QMAX, dequantize_block, \
+    quantize_block, quantize_host_int8
+from paddle_tpu.serve import router as router_mod
+from paddle_tpu.serve.router import Router
+
+pytestmark = pytest.mark.kvcompress
+
+VOCAB = 61
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = CausalLM(vocab=VOCAB, model_dim=16, num_heads=4, num_layers=2,
+                     ffn_dim=32, dropout=0.0, max_len=64)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    return model, variables
+
+
+def _engine(model, variables, **kw):
+    kw.setdefault("max_batch_size", 4)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("registry", MetricsRegistry())
+    return ServeEngine(model, variables, **kw)
+
+
+def _cache(**kw):
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("num_kv_heads", 2)
+    kw.setdefault("head_dim", 8)
+    kw.setdefault("registry", MetricsRegistry())
+    return PagedKVCache(**kw)
+
+
+# -- quantizer units -------------------------------------------------------
+
+def test_device_quant_roundtrip_within_one_step():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((3, 4, 2, 8)).astype(np.float32)
+    q, s = quantize_block(jnp.asarray(x))
+    assert q.dtype == jnp.int8 and s.shape == (3,)
+    back = np.asarray(dequantize_block(q, s, jnp.float32))
+    bound = np.asarray(s)[:, None, None, None] / QMAX + 1e-7
+    assert np.all(np.abs(back - x) <= bound)
+
+
+def test_device_scales_match_host_quantizer():
+    """A device-compressed block that spills to an int8 host tier must
+    carry the SAME scale the host quantizer would have produced — the
+    floors (1e-30 device, 1e-12 host) only engage below representable
+    KV magnitude, so on real content the two paths agree bit-for-bit
+    and the spill fast path never re-quantizes."""
+    rng = np.random.default_rng(1)
+    for _ in range(5):
+        x = rng.standard_normal((4, 2, 8)).astype(np.float32)
+        qd, sd = quantize_block(jnp.asarray(x)[None])
+        qh, sh = quantize_host_int8(x)
+        assert float(sd[0]) == sh
+        assert np.array_equal(np.asarray(qd[0]), qh)
+
+
+def test_host_fast_path_is_one_quant_step():
+    """HostKVTier.put_device_int8: an int8-mode tier stores the device
+    q/s VERBATIM (get() dequantizes with the original device scales —
+    one quant step total from fp); an fp-mode tier stores the exact
+    dequantization. Either way the round-trip error bound is scale/127,
+    never 2x."""
+    rng = np.random.default_rng(2)
+    fp = [(rng.standard_normal((4, 2, 8)).astype(np.float32),
+           rng.standard_normal((4, 2, 8)).astype(np.float32))
+          for _ in range(2)]
+    qlayers = []
+    for k, v in fp:
+        kq, ks = quantize_host_int8(k)
+        vq, vs = quantize_host_int8(v)
+        qlayers.append((kq, ks, vq, vs))
+    for int8 in (True, False):
+        tier = HostKVTier(1 << 20, int8=int8, registry=MetricsRegistry())
+        assert tier.put_device_int8((1, 2, 3), qlayers, np.float32)
+        back = tier.get((1, 2, 3))
+        assert back is not None and len(back) == 2
+        for (k0, v0), (k1, v1), (kq, ks, vq, vs) in zip(fp, back, qlayers):
+            assert k1.dtype == np.float32
+            assert np.max(np.abs(k1 - k0)) <= ks / QMAX + 1e-7
+            assert np.max(np.abs(v1 - v0)) <= vs / QMAX + 1e-7
+        if int8:
+            # verbatim storage: the blob holds the device ints + scales
+            blob = tier._entries[(1, 2, 3)].blobs[0]
+            kq0, ks0, vq0, vs0, _ = blob
+            assert np.array_equal(kq0, qlayers[0][0])
+            assert ks0 == qlayers[0][1]
+
+
+# -- cache-level: compression is a copy ------------------------------------
+
+class TestCompressCold:
+    def test_shared_blocks_compress_without_touching_refs(self):
+        """Committed full blocks are content-immutable (the key IS the
+        content), so compressing a refcount-shared block is safe: the
+        fp copy and index entry survive, fp hits stay byte-exact, and
+        the int8 copy only matters once the fp copy is evicted."""
+        c = _cache(compress_blocks=8)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.alloc_sequence(2, toks)            # full hit: blocks shared
+        assert c.shared_blocks == 2
+        c.step_now = 10                       # both blocks long idle
+        assert c.compress_cold(idle_steps=4) == 2
+        assert [c.ref_count(b) for b in c.block_table(1)] == [2, 2]
+        assert tuple(toks[:4]) in c._cindex and tuple(toks) in c._cindex
+        # fp index entries untouched: a third admission still fp-hits
+        n = c.alloc_sequence(3, toks)
+        assert n == 7 and c.stats()["promote_total"] == 0
+        # staged pairs drain to the engine flush exactly once
+        assert len(c.drain_compress()) == 2
+        for s in (1, 2, 3):
+            c.free_sequence(s)
+        c.assert_quiesced()
+
+    def test_idle_gate_and_recompress_noop(self):
+        c = _cache(compress_blocks=8)
+        toks = list(range(8))
+        c.alloc_sequence(1, toks)
+        c.commit_prefill(1, 8)
+        c.free_sequence(1)                    # cached-free at step 0
+        c.step_now = 2
+        assert c.compress_cold(idle_steps=4) == 0      # not idle yet
+        c.step_now = 4
+        assert c.compress_cold(idle_steps=4) == 2
+        assert c.compress_cold(idle_steps=4) == 0      # already resident
+        c.drain_compress()
+        c.assert_quiesced()
+
+    def test_quiesced_rejects_undrained_stages(self):
+        c = _cache(compress_blocks=8)
+        c.alloc_sequence(1, list(range(8)))
+        c.commit_prefill(1, 8)
+        c.free_sequence(1)
+        c.step_now = 10
+        c.compress_cold(idle_steps=4)
+        with pytest.raises(RuntimeError):
+            c.assert_quiesced()
+        c.drain_compress()
+        c.assert_quiesced()
+
+
+# -- engine-level: compress -> evict fp -> promote is invisible ------------
+
+TAILS = [[21, 22, 23, 24], [31, 32, 33, 34], [41, 42, 43, 44]]
+
+
+def test_compress_promote_identity(model_and_vars):
+    """Warm-up, churn until the fp copies are evicted but the int8
+    copies survive, then resubmit: the promoted prefix must reproduce
+    the cold run's greedy output, on the ONE compiled step."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=24)
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]
+    cold = eng.generate([prompt], max_new_tokens=6)
+    eng.generate([[50] * 8], max_new_tokens=8)         # lets prompt idle
+    for i in range(3):                                 # evict fp copies
+        eng.generate([[30 + i] * 16], max_new_tokens=12)
+    bs = eng.cache.block_size
+    assert tuple(prompt[:bs]) not in eng.cache._index  # fp copy gone
+    assert tuple(prompt[:bs]) in eng.cache._cindex     # int8 copy alive
+    warm = eng.generate([prompt], max_new_tokens=6)
+    assert warm == cold
+    st = eng.cache.stats()
+    assert st["promote_total"] >= 3 and st["compress_total"] > 0
+    assert st["compress_hit_tokens"] > 0
+    assert eng.obs.get("ptpu_kv_promote_total").value == st["promote_total"]
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+def test_preempt_compress_revive_completes(model_and_vars):
+    """A tight pool preempts; with the compressed tier (and no host
+    tier) the victims' committed blocks demote to int8 on device and
+    promote on re-admission. Every request must complete at full
+    length on the one compiled step."""
+    model, variables = model_and_vars
+    prompts = [[7, 3, 7, 3] + t for t in TAILS]
+    roomy = _engine(model, variables, max_batch_size=3, num_blocks=64)
+    want = roomy.generate(prompts, max_new_tokens=12)
+    tight = _engine(model, variables, max_batch_size=3, num_blocks=9,
+                    kv_compress_blocks=16)
+    got = tight.generate(prompts, max_new_tokens=12)
+    assert [len(g) for g in got] == [len(w) for w in want]
+    assert sum(r.preemptions for r in tight.finished.values()) > 0
+    st = tight.cache.stats()
+    assert st["compress_total"] > 0
+    assert tight._step_fn._cache_size() == 1
+    tight.cache.assert_quiesced()
+
+
+def test_budget_zero_is_bit_identical_to_seed(model_and_vars):
+    """kv_compress_blocks=0 must reproduce the plain engine exactly:
+    same outputs, same cache stats, no compressed-tier series, and the
+    seed demote gate (no host tier -> no demotion walk) intact."""
+    model, variables = model_and_vars
+    prompts = [[7, 3, 7, 3] + t for t in TAILS]
+    a = _engine(model, variables, max_batch_size=3, num_blocks=9)
+    b = _engine(model, variables, max_batch_size=3, num_blocks=9,
+                kv_compress_blocks=0)
+    assert b.cache.compress_enabled is False
+    out_a = a.generate(prompts, max_new_tokens=12)
+    out_b = b.generate(prompts, max_new_tokens=12)
+    assert out_a == out_b
+    assert a.cache.stats() == b.cache.stats()
+    assert "compress_total" not in b.cache.stats()
+    assert b._step_fn._cache_size() == 1
+    b.cache.assert_quiesced()
+
+
+def test_compressed_pool_spills_to_host_tier(model_and_vars):
+    """Demotion ladder end to end: device fp -> device int8 -> host.
+    Churn past the compressed pool's capacity and the coldest entries
+    must land in the host tier (counted as compress_spills) instead of
+    vanishing."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=4,
+                  host_tier_bytes=1 << 20, kv_tier_int8=True)
+    eng.generate([[7, 3, 7, 3] + t for t in TAILS], max_new_tokens=8)
+    for i in range(4):
+        eng.generate([[30 + i] * 16], max_new_tokens=12)
+    st = eng.cache.stats()
+    assert st["compress_spills"] > 0
+    assert eng.host_tier.stats()["tier_entries"] > 0
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+# -- fleet directory: the device_int8 rung ---------------------------------
+
+def test_engine_advertises_device_int8_rows(model_and_vars):
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=24)
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9]
+    eng.generate([prompt], max_new_tokens=4)
+    eng.generate([[50] * 8], max_new_tokens=8)
+    for i in range(3):
+        eng.generate([[30 + i] * 16], max_new_tokens=12)
+    rows = eng.kv_prefix_directory()
+    int8_rows = [r for r in rows if r["tier"] == "device_int8"]
+    assert any(r["digest"] == prefix_digest(tuple(prompt[:4]))
+               for r in int8_rows)
+    assert all(set(r) == {"len", "digest", "tier"} for r in rows)
+
+
+def test_router_ranks_device_over_int8_over_host():
+    """Equal advertised lengths split on tier heat: a device fp prefix
+    beats a device int8 one (promotion costs a dequant pass) which
+    beats a host one (DMA revival)."""
+    assert router_mod._TIER_RANK == {"device": 2, "device_int8": 1,
+                                     "host": 0}
+    urls = [f"http://127.0.0.1:{9100 + i}" for i in range(3)]
+    router = Router(urls, enable_directory=True)
+    a, b, c = router.replicas
+    for r in router.replicas:
+        r.ready = True
+    prompt = list(range(12))
+    d8 = prefix_digest(prompt[:8])
+    a.prefixes = {(8, d8): "host"}
+    b.prefixes = {(8, d8): "device_int8"}
+    c.prefixes = {(8, d8): "device"}
+    assert router.plan_route(prompt)[0] is c
+    c.prefixes = {}
+    assert router.plan_route(prompt)[0] is b
+    # longest match still beats a hotter shorter one
+    a.prefixes = {(12, prefix_digest(prompt)): "host"}
+    assert router.plan_route(prompt)[0] is a

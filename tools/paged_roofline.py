@@ -26,6 +26,18 @@ E = (1-a^(K+1))/(1-a) tokens in expectation at per-token acceptance
 bytes_per_token / E, so the emitted-token ceiling scales by E. Output
 is unchanged when the flag is absent.
 
+`--compress-blocks C` models the in-device int8 compressed tier
+(engine `kv_compress_blocks` knob): a parallel C-block int8 pool holds
+cold prefix blocks at half the fp bytes (+4 B of scales per block per
+plane, negligible), so warm-prefix capacity grows to (NB + C) * BS
+tokens for C * BS * Hkv * Dh bytes/layer of extra HBM (the `qpool_gb`
+column). The `KB/t_mix` column is the streamed-bytes model at full
+mixed residency r = C / (NB + C): promoted blocks read as fp today, so
+this column models a kernel that reads int8-resident blocks in place
+(half bytes for the compressed fraction) — an optimistic bound on what
+direct-int8 decode could recover, not the shipped read path. Output is
+unchanged when the flag is absent.
+
 `--tp-size N` models tensor-parallel serving (engine `tp_size` knob):
 the KV pool is sharded over kv-heads, so the per-chip pool and the
 per-chip streamed bytes/token both drop by N, lifting the per-chip
@@ -177,6 +189,10 @@ def main():
     ap.add_argument("--spec-accept", type=float, default=0.7,
                     help="modelled per-token draft acceptance "
                     "probability for the --spec-k columns")
+    ap.add_argument("--compress-blocks", type=int, default=0,
+                    help="model the device int8 compressed tier: "
+                    "effective-pool and mixed-residency streamed-bytes "
+                    "columns for a C-block int8 side pool")
     ap.add_argument("--tp-size", type=int, default=1,
                     help="model tensor-parallel serving: per-chip "
                     "pool/bytes columns (/N) plus the decode-MLP "
@@ -217,9 +233,19 @@ def main():
               f"(E[emitted] = "
               + ", ".join(f"k={k}: {expected_emitted(k, args.spec_accept):.2f}"
                           for k in spec_ks) + ")")
+    cb = args.compress_blocks
+    if cb < 0:
+        raise SystemExit(f"--compress-blocks {cb} must be >= 0")
+    if cb:
+        print(f"compress: {cb}-block int8 side pool; eff_tok counts "
+              f"warm-prefix capacity, KB/t_mix models direct int8 "
+              f"reads at full residency (optimistic bound)")
     hdr = (f"{'BS':>4} {'NB':>6} {'pool_gb':>8} {'%hbm':>6} "
            f"{'cap_tok':>8} {'ctx/row':>8} {'KB/tok':>8} "
            f"{'tok_s_ceil':>10}")
+    if cb:
+        hdr += (f" {'qpool_gb':>8} {'eff_tok':>8} {'KB/t_mix':>8} "
+                f"{'tok_s_mix':>10}")
     if tp > 1:
         hdr += (f" {'chip_gb':>8} {'KB/t/chip':>9} {'ar_fp_KB':>8} "
                 f"{'ar_i8_KB':>8} {'tok_s_chip':>10}")
@@ -241,6 +267,16 @@ def main():
             line = (f"{bs:>4} {nb:>6} {pool/1e9:>8.3f} {frac*100:>5.1f}% "
                     f"{cap:>8} {ctx:>8} {bpt/1e3:>8.1f} "
                     f"{ceil_tok:>10.0f}")
+            if cb:
+                # int8 side pool: half the fp bytes per block (scales
+                # are 4 B per plane per block — noise at this scale)
+                qpool = kv_pool_bytes(L, cb, bs, Hkv, Dh) // 2
+                eff_tok = (nb + cb) * bs
+                r = cb / (nb + cb)
+                bpt_mix = bpt * (1.0 - r / 2.0)
+                line += (f" {qpool/1e9:>8.3f} {eff_tok:>8} "
+                         f"{bpt_mix/1e3:>8.1f} "
+                         f"{args.hbm_gbps * 1e9 / bpt_mix:>10.0f}")
             if tp > 1:
                 # kv-head sharding: per-chip pool AND per-chip streamed
                 # bytes are exactly 1/tp of the replicated numbers, so

@@ -130,8 +130,9 @@ One JSON line per cell on stdout, PRINTED AS SOON AS MEASURED
 Exit code: 0 iff every scenario's verdict holds.
 
 Run: python tools/serve_bench.py
-     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|tp|
-                 router|fleet_chaos|disagg|soak|fleet_admission]
+     [--scenario all|batch|prefix|chunked|mixed|spec|nbest|tiered|
+                 compress|tp|router|fleet_chaos|disagg|soak|
+                 fleet_admission]
      [--metrics-out FILE]   # dump the last verdict engine's Prometheus
                             # exposition at end of run
      [--trace-out FILE]     # dump the last in-process verdict engine's
@@ -809,6 +810,110 @@ def scenario_tiered(model, variables, args):
           "int8_complete": int8_complete,
           "int8_tokens_identical":
               bool(q["warm_outs"] == ref_outs)})   # informational only
+    return ok
+
+
+# -- scenario: in-device int8 KV compression on a tight pool ---------------
+
+def _run_compress_cell(model, variables, args, prompts, budget):
+    """Two concurrent bursts of the same prefix-sharing workload on one
+    tight-pool engine. The second burst re-requests every prompt after
+    the first burst's churn — with the compressed tier attached the
+    evicted system prefix promotes back from int8 instead of
+    re-prefilling. Emitted AS MEASURED (the early-flush contract)."""
+    tag = "_on" if budget else "_off"
+    eng = make_engine(model, variables, args, block_size=4,
+                      num_blocks=args.compress_num_blocks,
+                      max_prefill_tokens=64,
+                      kv_compress_blocks=budget)
+    eng.generate([[args.vocab - 1] * len(prompts[0])],
+                 max_new_tokens=2)                  # compile untimed
+    eng.reset_stats()
+    t0 = time.perf_counter()
+    outs = []
+    for _ in range(2):
+        for p in prompts:
+            eng.add_request(p, max_new_tokens=args.compress_new_tokens)
+        burst = eng.run()
+        outs.extend(burst[k] for k in sorted(burst))
+    wall = time.perf_counter() - t0
+    st = eng.cache.stats()
+    pre = int(eng.obs.get("ptpu_sched_preemptions_total").value)
+    hit_rate = st["hit_tokens"] / max(st["prompt_tokens"], 1)
+    eng.cache.assert_quiesced()
+    emit({"cell": f"compress{tag}", "requests": 2 * len(prompts),
+          "prompt_len": len(prompts[0]),
+          "pool_blocks": args.compress_num_blocks,
+          "compress_blocks": budget,
+          "wall_s": round(wall, 3),
+          "preemptions": pre,
+          "hit_rate": round(hit_rate, 4),
+          "compress_total": st.get("compress_total", 0),
+          "promote_total": st.get("promote_total", 0),
+          "compressed_resident": st.get("compressed_blocks", 0),
+          "effective_pool_bytes": eng.cache.effective_pool_bytes(),
+          "compiles": int(eng._step_fn._cache_size())})
+    return {"eng": eng, "outs": outs, "pre": pre, "hit_rate": hit_rate,
+            "stats": st, "compiles": int(eng._step_fn._cache_size())}
+
+
+def scenario_compress(model, variables, args):
+    """A/B the device int8 compressed tier on a pool sized to force
+    preemption: compression on must sustain strictly fewer preemptions
+    and a higher prefix hit rate than off, with the off run
+    byte-identical to a roomy reference (budget 0 IS the seed engine)
+    and the on run completion-gated (greedy decode over promoted
+    blocks stays within one quant step — at bench scale that lands on
+    the same argmax, reported informationally)."""
+    global LAST_EXPOSITION, LAST_TRACER
+    rng = np.random.default_rng(8)
+    system = rng.integers(0, args.vocab - 1,
+                          args.compress_system_len).tolist()
+    prompts = [system + rng.integers(0, args.vocab - 1,
+                                     args.compress_tail_len).tolist()
+               for _ in range(args.compress_requests)]
+
+    # identity bar: ample pool, compression off
+    ref = make_engine(model, variables, args, block_size=4,
+                      num_blocks=args.num_blocks, max_prefill_tokens=64)
+    ref.generate([[args.vocab - 1] * len(prompts[0])], max_new_tokens=2)
+    ref.reset_stats()
+    ref_outs = []
+    for _ in range(2):
+        for p in prompts:
+            ref.add_request(p, max_new_tokens=args.compress_new_tokens)
+        burst = ref.run()
+        ref_outs.extend(burst[k] for k in sorted(burst))
+
+    off = _run_compress_cell(model, variables, args, prompts, budget=0)
+    on = _run_compress_cell(model, variables, args, prompts,
+                            budget=args.compress_budget_blocks)
+    LAST_EXPOSITION = on["eng"].metrics_text()
+    LAST_TRACER = on["eng"].tracer
+
+    off_identical = off["outs"] == ref_outs
+    on_complete = bool(
+        len(on["outs"]) == len(ref_outs)
+        and all(len(a) == len(b) > 0
+                for a, b in zip(on["outs"], ref_outs)))
+    ok = bool(off_identical
+              and on_complete
+              and off["pre"] > 0
+              and on["pre"] < off["pre"]
+              and on["hit_rate"] > off["hit_rate"]
+              and on["stats"]["compress_total"] > 0
+              and on["stats"]["promote_total"] > 0
+              and off["compiles"] == 1 and on["compiles"] == 1)
+    emit({"cell": "compress_verdict", "ok": ok,
+          "off_identical_to_roomy": bool(off_identical),
+          "on_complete": on_complete,
+          "preemptions_off": off["pre"], "preemptions_on": on["pre"],
+          "hit_rate_off": round(off["hit_rate"], 4),
+          "hit_rate_on": round(on["hit_rate"], 4),
+          "compress_total": on["stats"]["compress_total"],
+          "promote_total": on["stats"]["promote_total"],
+          "on_identical_to_roomy":
+              bool(on["outs"] == ref_outs)})       # informational only
     return ok
 
 
@@ -2096,7 +2201,8 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
-                             "mixed", "spec", "nbest", "tiered", "tp",
+                             "mixed", "spec", "nbest", "tiered",
+                             "compress", "tp",
                              "router", "fleet_chaos", "disagg",
                              "soak", "fleet_admission"])
     ap.add_argument("--requests", type=int, default=8)
@@ -2122,6 +2228,22 @@ def main():
                     "cached-free block (demotion pressure)")
     ap.add_argument("--tier-host-bytes", type=int, default=8 << 20,
                     help="host-tier byte budget for the tiered scenario")
+    # compress scenario (device int8 compressed tier, tight pool)
+    ap.add_argument("--compress-num-blocks", type=int, default=16,
+                    help="block pool size for the compress scenario — "
+                    "small enough that the concurrent burst preempts "
+                    "(block_size is pinned to 4 in this scenario)")
+    ap.add_argument("--compress-budget-blocks", type=int, default=48,
+                    help="kv_compress_blocks for the compression-on "
+                    "cell (the int8 side pool, in blocks)")
+    ap.add_argument("--compress-system-len", type=int, default=24,
+                    help="shared system-prompt length for the "
+                    "compress scenario's prefix-sharing workload")
+    ap.add_argument("--compress-tail-len", type=int, default=8)
+    ap.add_argument("--compress-requests", type=int, default=6,
+                    help="requests per burst (two bursts are served; "
+                    "the second re-requests every prompt after churn)")
+    ap.add_argument("--compress-new-tokens", type=int, default=16)
     # router scenario (replica fleet + scraped verdicts)
     ap.add_argument("--router-system-len", type=int, default=16,
                     help="shared system-prompt length per prefix group "
@@ -2161,7 +2283,8 @@ def main():
     scenarios = {"batch": scenario_batch, "prefix": scenario_prefix,
                  "chunked": scenario_chunked, "mixed": scenario_mixed,
                  "spec": scenario_spec, "nbest": scenario_nbest,
-                 "tiered": scenario_tiered, "tp": scenario_tp,
+                 "tiered": scenario_tiered,
+                 "compress": scenario_compress, "tp": scenario_tp,
                  "router": scenario_router,
                  "fleet_chaos": scenario_fleet_chaos,
                  "disagg": scenario_disagg,
