@@ -400,10 +400,15 @@ class PagedKVCache:
         Returns blocks staged."""
         if not self._compress_on or not self._cfree:
             return 0
+        # blocks whose device contents are not real yet — a staged
+        # host-load dst (DMA flushes AFTER compressions) or a staged
+        # promote dst — must never feed the quantize lanes this step
+        inflight = {b for b, _ in self._pending_host_loads}
+        inflight |= {b for b, _ in self._pending_promotes}
         cands = sorted(
             (self._last_hit.get(b, 0), b)
             for b, key in self._key_of.items()
-            if key not in self._cindex
+            if key not in self._cindex and b not in inflight
             and self.step_now - self._last_hit.get(b, 0) >= idle_steps)
         staged = 0
         for _, b in cands:
@@ -552,6 +557,7 @@ class PagedKVCache:
             self._refs[b] = 1
             host_blocks.append(b)
             self._pending_host_loads.append((b, layers))
+            self._last_hit[b] = self.step_now
             if key not in self._index and b not in self._key_of:
                 self._index[key] = b
                 self._key_of[b] = key
@@ -854,15 +860,28 @@ class PagedKVCache:
     def compressed_resident(self) -> int:
         return len(self._cindex)
 
+    @property
+    def compress_free_slots(self) -> int:
+        """Unused int8 slots — the scheduler's victim costing caps the
+        cheap-rung credit by this (a forced demotion beyond it spills
+        warmer entries or, with no host tier, drops content)."""
+        return len(self._cfree)
+
     def effective_pool_bytes(self) -> int:
-        """fp-equivalent bytes of KV the device currently holds: the
-        fp pool plus every RESIDENT compressed entry counted at the fp
-        bytes it stands in for. Reaches (num_blocks-1 + compress_blocks)
-        x block-bytes when the int8 pool is full — the ~2x-effective-
-        pool headline, sampled into ptpu_kv_pool_effective_bytes."""
+        """fp-equivalent bytes of UNIQUE KV the device currently holds:
+        the fp pool plus compressed entries whose content lives ONLY in
+        the int8 tier. Proactively compressed blocks keep their fp copy
+        resident (compress_cold), so counting every _cindex entry would
+        double-count content present in both tiers; an entry counts
+        only once its fp index entry is gone (the block was evicted or
+        was never fp-resident). Reaches (num_blocks-1 + compress_blocks)
+        x block-bytes when the int8 pool is full of fp-evicted content
+        — the ~2x-effective-pool headline, sampled into
+        ptpu_kv_pool_effective_bytes."""
         blk = (2 * self.block_size * self.num_kv_heads * self.head_dim
                * np.dtype(self.dtype).itemsize * len(self.pools))
-        return (self.num_blocks - 1 + len(self._cindex)) * blk
+        uniq = sum(1 for k in self._cindex if k not in self._index)
+        return (self.num_blocks - 1 + uniq) * blk
 
     # -- observability ----------------------------------------------------
     def hit_rate(self) -> float:

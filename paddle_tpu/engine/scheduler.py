@@ -332,8 +332,13 @@ class Scheduler:
         (~tail^2) — which is why long-context victims flip from worst
         choice to best under a tier. The in-device int8 rung is
         CHEAPER still: demotion and promotion are on-device lane
-        scatters (no host DMA on either side), so full blocks cost a
-        fraction of the host rung's weight."""
+        scatters (no host DMA on either side) — but only as many blocks
+        as the int8 pool has FREE slots get that rate; a demotion
+        beyond that spills to the host rung (with a tier) or drops
+        content entirely (without one, making it recompute-only), so
+        the cheap credit is capped by free-slot capacity rather than
+        handed to every committed block of an arbitrarily long
+        victim."""
         n = len(req.tokens)
         if self.cache.host_tier is None \
                 and not self.cache.compress_enabled:
@@ -341,7 +346,13 @@ class Scheduler:
         full = (n // self.cache.block_size) * self.cache.block_size
         tail = n - full
         if self.cache.compress_enabled:
-            return float(full * 0.25 + tail * tail)
+            cheap = min(full,
+                        self.cache.compress_free_slots
+                        * self.cache.block_size)
+            rest = full - cheap
+            if self.cache.host_tier is not None:
+                return float(cheap * 0.25 + rest + tail * tail)
+            return float(cheap * 0.25 + rest * rest + tail * tail)
         return float(full + tail * tail)
 
     def _pick_victim(self, keep: Request) -> Optional[Request]:

@@ -167,6 +167,32 @@ class TestCompressCold:
         c.drain_compress()
         c.assert_quiesced()
 
+    def test_host_load_dst_not_compressed_same_step(self):
+        """REGRESSION: a host-revival dst block holds stale device
+        bytes until the engine flushes its DMA, and the DMA flushes
+        AFTER the quantize lanes (_flush_compress runs first) — so
+        staging a compress of it would encode garbage into the int8
+        tier under a real prefix key. The dst is stamped hot at
+        admission AND skipped outright while its load is pending."""
+        tier = HostKVTier(1 << 20, registry=MetricsRegistry())
+        c = _cache(compress_blocks=8, host_tier=tier)
+        rng = np.random.default_rng(3)
+        toks = list(range(8))
+        for end in (4, 8):
+            layers = [(rng.standard_normal((4, 2, 8)).astype(np.float32),
+                       rng.standard_normal((4, 2, 8)).astype(np.float32))]
+            assert tier.put(tuple(toks[:end]), layers, reason="preempt")
+        c.step_now = 50              # mid-serve: idle gate wide open
+        assert c.alloc_sequence(1, toks) == 7
+        assert len(c._pending_host_loads) == 2
+        assert c.compress_cold(idle_steps=4) == 0
+        assert c.drain_compress() == []
+        for b, _ in c._pending_host_loads:
+            assert c._last_hit[b] == 50      # stamped at admission
+        c.drain_host_loads()
+        c.free_sequence(1)
+        c.assert_quiesced()
+
     def test_quiesced_rejects_undrained_stages(self):
         c = _cache(compress_blocks=8)
         c.alloc_sequence(1, list(range(8)))
