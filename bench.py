@@ -625,6 +625,38 @@ def _serving_bench(requests: int = 8, new_tokens: int = 32):
     step_h = eng.obs.get("ptpu_serve_step_ms")
     decode_steps = sum(c.count for kind, c in step_h.children().items()
                        if kind != ("prefill",))
+    # direct-read columns (ISSUE 20): repeat traffic against a
+    # compression-enabled engine. The cold turn caches the prompt,
+    # filler churn evicts its blocks into the int8 tier, and the warm
+    # turn re-reads them IN PLACE (kv_promote_hits=0, no promote
+    # round-trip). The streamed-KB/token pair prices the warm decode's
+    # per-token KV traffic twice — all-fp account vs the measured
+    # mixed-residency account (int8-resident tokens at 1 B/elem).
+    lg.disabled = True
+    try:
+        eng2 = ServeEngine(model, variables, max_batch_size=4,
+                           block_size=4, num_blocks=24, spec_k=4,
+                           kv_compress_blocks=256, kv_promote_hits=0,
+                           registry=MetricsRegistry())
+        prompt = prompts[0][:23]    # off block stride: the final
+        # partial block stays fp-writable, so no forced promote
+        eng2.generate([list(prompt)], max_new_tokens=4)      # cold
+        for _ in range(6):          # churn: evict into the int8 tier
+            eng2.generate([rng.integers(0, 127, 33).tolist()],
+                          max_new_tokens=2)
+        eng2.reset_stats()
+        eng2.generate([list(prompt)], max_new_tokens=4)      # warm
+    finally:
+        lg.disabled = prev_disabled
+    c2 = eng2.cache
+    st2 = c2.stats()
+    direct_toks = int(st2.get("direct_int8_tokens", 0))
+    itemsize = jnp.dtype(c2.dtype).itemsize
+    per_tok_fp = len(c2.pools) * 2 * c2.num_kv_heads * c2.head_dim \
+        * itemsize
+    ctx = -(-len(prompt) // c2.block_size) * c2.block_size
+    mix_bytes = (ctx - direct_toks) * per_tok_fp \
+        + direct_toks * (per_tok_fp // itemsize)
     return {
         "serve_decode_tok_per_sec": round(gen / max(wall, 1e-9), 1),
         "serve_ttft_p99_ms": round(ttft.quantile(0.99), 3),
@@ -636,6 +668,12 @@ def _serving_bench(requests: int = 8, new_tokens: int = 32):
         # MEASURED off the pool arrays' addressable shards
         "serve_tp_size": eng.tp_size,
         "serve_kv_pool_bytes_per_chip": eng.cache.per_chip_pool_bytes(),
+        "serve_kv_direct_int8_reads": int(st2.get("direct_int8_reads",
+                                                  0)),
+        "serve_kv_direct_int8_tokens": direct_toks,
+        "serve_kv_streamed_kb_per_tok_fp": round(ctx * per_tok_fp / 1e3,
+                                                 3),
+        "serve_kv_streamed_kb_per_tok_mix": round(mix_bytes / 1e3, 3),
     }
 
 

@@ -174,6 +174,7 @@ class ServeEngine:
                  kv_tier_int8: bool = False,
                  tier_spill_dir: Optional[str] = None,
                  kv_compress_blocks: int = 0,
+                 kv_promote_hits: int = 0,
                  tp_size: int = 1,
                  demote_finished: bool = False):
         self.model = model
@@ -305,14 +306,18 @@ class ServeEngine:
         # compression"): kv_compress_blocks > 0 gives the cache a
         # parallel int8 block pool cold prefix blocks quantize into at
         # ~half the bytes — the rung between device-fp and the host
-        # tier. 0 reproduces today's behavior bit for bit.
+        # tier. 0 reproduces today's behavior bit for bit. Compressed
+        # hits are read IN PLACE by the mixed ragged step by default;
+        # kv_promote_hits opts back into fp promotion (1 = always, the
+        # PR-19 behavior; N > 1 = warm-up threshold).
         self.cache = PagedKVCache(
             num_layers=len(model.blocks), num_blocks=num_blocks,
             block_size=block_size, num_kv_heads=attn.num_kv_heads,
             head_dim=attn.head_dim, dtype=model.dtype,
             enable_prefix_cache=enable_prefix_cache, registry=self.obs,
             host_tier=self.host_tier,
-            compress_blocks=kv_compress_blocks, tp_size=self.tp_size,
+            compress_blocks=kv_compress_blocks,
+            promote_hits=kv_promote_hits, tp_size=self.tp_size,
             mesh=self._mesh)
         if self.host_tier is not None:
             # prime the eager kernels tier traffic dispatches — the
@@ -401,10 +406,18 @@ class ServeEngine:
             var_sh = self._tp_rules.tree_shardings(self._mesh,
                                                    self.variables)
             pools_sh = [(pool_s, pool_s)] * nl
+            # int8 pools shard over kv-heads like the fp pools; the
+            # per-block scales are head-independent scalars, replicated.
+            # Compression off -> empty lists, a stable pytree prefix.
+            qpools_sh = ([(pool_s, pool_s)] * nl
+                         if self.cache.compress_enabled else [])
+            qscales_sh = ([(rep, rep)] * nl
+                          if self.cache.compress_enabled else [])
             jit_step = functools.partial(
                 jax.jit,
-                in_shardings=(var_sh, rep, rep, pools_sh, rep, rep, rep,
-                              rep, rep, rep, rep),
+                in_shardings=(var_sh, rep, rep, pools_sh, qpools_sh,
+                              qscales_sh, rep, rep, rep, rep, rep, rep,
+                              rep),
                 out_shardings=(rep, pools_sh))
             jit_copy = functools.partial(
                 jax.jit,
@@ -412,13 +425,14 @@ class ServeEngine:
                 out_shardings=pools_sh)
 
         @jit_step
-        def _step_fn(variables, tokens, positions, pools, block_tables,
-                     context_lens, q_starts, tile_rows, tile_offs, slots,
-                     last_idx):
+        def _step_fn(variables, tokens, positions, pools, qpools, qscales,
+                     block_tables, context_lens, q_starts, tile_rows,
+                     tile_offs, slots, last_idx):
             return model_.ragged_step_paged(
                 _fresh_cx(variables), tokens, positions, pools,
                 block_tables, context_lens, q_starts, tile_rows,
-                tile_offs, slots, last_idx, tp=serve_tp)
+                tile_offs, slots, last_idx, tp=serve_tp,
+                qpools=qpools, qscales=qscales)
 
         @jit_copy
         def _copy_blocks(pools, src, dst):
@@ -791,6 +805,15 @@ class ServeEngine:
                 self.cache.pools[li] = (kp.at[bdst].set(kfp),
                                         vp.at[bdst].set(vfp))
 
+    @property
+    def kv_direct_int8(self) -> bool:
+        """Whether this replica's compiled step reads int8-resident
+        blocks in place (no promote round-trip). Advertised as the
+        `direct_int8` capability field on /kvprefixes so the router can
+        re-price this replica's device_int8 directory rung to near
+        device-fp; older replicas never send the field."""
+        return self.cache.compress_enabled and self.cache.direct_read_enabled
+
     def kv_prefix_directory(self, limit: int = 512) -> List[dict]:
         """This replica's fleet-directory advertisement: the warm
         prefixes it can serve without re-prefill, as
@@ -928,10 +951,11 @@ class ServeEngine:
             cursor += ntiles * tq
         logits, self.cache.pools = self._step_fn(
             self.variables, jnp.asarray(tokens), jnp.asarray(positions),
-            self.cache.pools, jnp.asarray(block_tables),
-            jnp.asarray(context_lens), jnp.asarray(q_starts),
-            jnp.asarray(tile_rows), jnp.asarray(tile_offs),
-            jnp.asarray(slots), jnp.asarray(last_idx))
+            self.cache.pools, self.cache.qpools, self.cache.qscales,
+            jnp.asarray(block_tables), jnp.asarray(context_lens),
+            jnp.asarray(q_starts), jnp.asarray(tile_rows),
+            jnp.asarray(tile_offs), jnp.asarray(slots),
+            jnp.asarray(last_idx))
         logits = np.asarray(logits)
         chunks = [w for w in rows if not w.decode]
         decodes = [w for w in rows if w.decode]

@@ -97,11 +97,14 @@ class PagedKVCache:
                  registry: Optional[MetricsRegistry] = None,
                  host_tier: Optional["HostKVTier"] = None,
                  compress_blocks: int = 0,
+                 promote_hits: int = 0,
                  tp_size: int = 1, mesh=None):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (block 0 is scratch)")
         if compress_blocks < 0:
             raise ValueError(f"compress_blocks {compress_blocks} < 0")
+        if promote_hits < 0:
+            raise ValueError(f"promote_hits {promote_hits} < 0")
         if tp_size < 1:
             raise ValueError(f"tp_size {tp_size} < 1")
         if num_kv_heads % tp_size != 0:
@@ -169,12 +172,24 @@ class PagedKVCache:
         self._pending_compress: List[Tuple[int, int]] = []  # (fp blk, slot)
         self._pending_promotes: List[Tuple[int, int]] = []  # (fp blk, slot)
         self._promote_slots: Set[int] = set()
+        # direct-read plumbing: a compressed hit is served IN PLACE —
+        # the block table carries the bias-encoded slot (-(slot+1)) and
+        # the ragged step dequantizes it inside the kernel — instead of
+        # claiming an fp block and staging a promote. promote_hits is
+        # the opt-in warm-up threshold: 0 never promotes, 1 restores the
+        # always-promote PR-19 behavior, N>1 promotes a key once it has
+        # been hit N times (hot prefixes graduate back to fp reads).
+        self.promote_hits = int(promote_hits)
+        self._cslot_refs: Dict[int, int] = {}     # slot -> live direct readers
+        self._chits: Dict[tuple, int] = {}        # key -> compressed-hit count
         self._last_hit: Dict[int, int] = {}           # block -> step
         self.step_now = 0
         self.compressed_total = 0         # blocks quantized in-device
         self.promoted_total = 0           # compressed blocks re-inflated
         self.compress_spills = 0          # cslot evictions (-> host/gone)
         self.compress_hit_tokens = 0      # prompt tokens served int8
+        self.direct_reads = 0             # int8 blocks read in place
+        self.direct_read_tokens = 0       # prompt tokens they covered
         # block 0 reserved for padded/dummy rows — never handed out
         self._free = deque(range(1, num_blocks))
         self._tables: Dict[int, List[int]] = {}
@@ -231,6 +246,12 @@ class PagedKVCache:
         self._c_promote = reg.counter(
             "ptpu_kv_promote_total",
             "Compressed blocks dequantized back into fp on a prefix hit")
+        self._c_direct_reads = reg.counter(
+            "ptpu_kv_direct_int8_reads_total",
+            "Int8-resident blocks read in place by the ragged step")
+        self._c_direct_toks = reg.counter(
+            "ptpu_kv_direct_int8_tokens_total",
+            "Prompt tokens served by direct int8 reads")
 
     # -- capacity ---------------------------------------------------------
     def pool_shape(self, tp_size: Optional[int] = None) -> Tuple[int, ...]:
@@ -351,19 +372,23 @@ class PagedKVCache:
         entry's slot, after spilling that entry one rung further down.
         Slots with in-flight lane traffic are not evictable: a
         pending-compress dst holds no payload yet (spilling it would
-        read scratch garbage) and a pending-promote src is about to be
-        read by the flush. Returns None when nothing can move; the
-        caller falls through to the host rung."""
+        read scratch garbage), a pending-promote src is about to be
+        read by the flush, and a slot with live direct readers
+        (_cslot_refs) is part of a running sequence's block table.
+        Returns None when nothing can move; the caller falls through
+        to the host rung."""
         if self._cfree:
             return self._cfree.popleft()
         busy = {s for _, s in self._pending_compress}
         busy |= self._promote_slots
+        busy |= set(self._cslot_refs)
         for key, slot in self._cindex.items():     # coldest first
             if slot in busy:
                 continue
             self._spill_cslot(key, slot)
             del self._cindex[key]
             del self._cslot_key[slot]
+            self._chits.pop(key, None)   # warm-up clock dies with the entry
             return slot
         return None
 
@@ -377,13 +402,18 @@ class PagedKVCache:
         self.compress_spills += 1
         if self.host_tier is None or self.host_tier.contains(key):
             return
+        self.host_tier.put_device_int8(key, self._slot_qlayers(slot),
+                                       self.dtype, reason="evict")
+
+    def _slot_qlayers(self, slot: int) -> list:
+        """One int8 slot's per-layer (kq, kscale, vq, vscale) payload —
+        the device_int8 wire/tier encoding (kvtier.put_device_int8)."""
         qlayers = []
         for li, (kq, vq) in enumerate(self.qpools):
             ks, vs = self.qscales[li]
             qlayers.append((np.asarray(kq[slot]), float(ks[slot]),
                             np.asarray(vq[slot]), float(vs[slot])))
-        self.host_tier.put_device_int8(key, qlayers, self.dtype,
-                                       reason="evict")
+        return qlayers
 
     def compress_cold(self, idle_steps: int = 4,
                       max_blocks: Optional[int] = None) -> int:
@@ -442,8 +472,25 @@ class PagedKVCache:
         bs = self.block_size
         count = 0
         for bi in range(self._committed.get(seq_id, 0) // bs):
-            key = self._key_of.get(table[bi]) or tuple(toks[:(bi + 1) * bs])
-            if self._demote_block(table[bi], key, reason):
+            b = table[bi]
+            if b < 0:
+                # bias-encoded direct-read entry: the content already
+                # lives in the int8 tier, so preempt-demotion is a
+                # no-op. Finish-demotion feeds the fleet transfer plane
+                # from the HOST tier — ship the int8 payload down the
+                # spill fast path (one quant step total, no fp detour).
+                slot = -b - 1
+                key = (self._cslot_key.get(slot)
+                       or tuple(toks[:(bi + 1) * bs]))
+                if reason == "finish" and self.host_tier is not None \
+                        and not self.host_tier.contains(key):
+                    if self.host_tier.put_device_int8(
+                            key, self._slot_qlayers(slot), self.dtype,
+                            reason=reason):
+                        count += 1
+                continue
+            key = self._key_of.get(b) or tuple(toks[:(bi + 1) * bs])
+            if self._demote_block(b, key, reason):
                 count += 1
         return count
 
@@ -492,29 +539,38 @@ class PagedKVCache:
         n = len(tokens)
         bs = self.block_size
         matched = self._match_prefix(tokens)
-        # walk PAST the device-fp match into the compressed tier: each
-        # hit will claim a fresh fp block and stage a fixed-lane
-        # dequantize promotion the engine flushes before the step (and
-        # ahead of COW) reads it
-        promo: List[Tuple[tuple, int]] = []
+        # walk PAST the device-fp match into the compressed tier. Each
+        # hit is served IN PLACE by default: the table entry carries the
+        # bias-encoded slot (-(slot+1)) and the ragged step dequantizes
+        # the block inside the kernel — no fp claim, no promote lanes.
+        # A hit claims a fresh fp block + staged dequantize promotion
+        # only when the warm-up threshold says so (promote_hits; see
+        # __init__) or when the hit is the prompt's FINAL block: the
+        # full-prompt cap recomputes token n-1, and its write must land
+        # in a writable fp block, never an int8 slot.
+        chits: List[Tuple[tuple, int, bool]] = []   # (key, slot, promote?)
         if self._compress_on:
             for end in range((len(matched) + 1) * bs, n + 1, bs):
-                slot = self._cindex.get(tuple(tokens[:end]))
+                key = tuple(tokens[:end])
+                slot = self._cindex.get(key)
                 if slot is None:
                     break
-                promo.append((tuple(tokens[:end]), slot))
+                hits = self._chits.get(key, 0) + 1
+                chits.append((key, slot,
+                              end >= n or 0 < self.promote_hits <= hits))
         # ... and past THAT into the host tier: every hit is fetched
         # now (the payload is pinned here — a later demotion's LRU
         # eviction between admission and flush can't revoke it)
         host_loads: List[Tuple[tuple, list]] = []
         if self.host_tier is not None and self.enable_prefix_cache:
-            for end in range((len(matched) + len(promo) + 1) * bs,
+            for end in range((len(matched) + len(chits) + 1) * bs,
                              n + 1, bs):
                 layers = self.host_tier.get(tuple(tokens[:end]))
                 if layers is None:
                     break
                 host_loads.append((tuple(tokens[:end]), layers))
-        need = self.blocks_for(n) - len(matched)
+        n_direct = sum(1 for _, _, p in chits if not p)
+        need = self.blocks_for(n) - len(matched) - n_direct
         revive = [b for b in matched if b not in self._refs]
         if need + len(revive) > len(self._free):
             raise CacheExhausted(
@@ -528,20 +584,30 @@ class PagedKVCache:
                 self.cached_free_revivals += 1
                 self._c_revive.inc()
             self._last_hit[b] = self.step_now
-        # compressed hits claim fresh fp blocks and stage dequantize
-        # promotions. Pin every promo slot FIRST: the _pop_free calls
+        # Pin every compressed hit's slot FIRST: the _pop_free calls
         # below can themselves demote dying cached-free entries into
         # the int8 pool, and a full pool would otherwise evict (spill)
-        # the very slot we are about to promote from.
-        promo_blocks: List[int] = []
-        if promo:
-            self._promote_slots.update(s for _, s in promo)
-            for key, slot in promo:
+        # the very slots this table is about to read or promote from.
+        mid_blocks: List[int] = []      # compressed hits, in table order
+        n_promoted = 0
+        if chits:
+            self._promote_slots.update(s for _, s, p in chits if p)
+            for _, s, p in chits:
+                if not p:
+                    self._cslot_refs[s] = self._cslot_refs.get(s, 0) + 1
+            for key, slot, p in chits:
+                self._chits[key] = self._chits.get(key, 0) + 1
+                self._cindex.move_to_end(key)        # LRU touch: hottest
+                if not p:
+                    mid_blocks.append(-(slot + 1))
+                    self.direct_reads += 1
+                    self._c_direct_reads.inc()
+                    continue
                 b = self._pop_free()
                 self._refs[b] = 1
-                promo_blocks.append(b)
+                mid_blocks.append(b)
+                n_promoted += 1
                 self._pending_promotes.append((b, slot))
-                self._cindex.move_to_end(key)        # LRU touch: hottest
                 self._last_hit[b] = self.step_now
                 if key not in self._index and b not in self._key_of:
                     self._index[key] = b
@@ -562,22 +628,25 @@ class PagedKVCache:
                 self._index[key] = b
                 self._key_of[b] = key
         fresh = [self._pop_free()
-                 for _ in range(need - len(promo_blocks) - len(host_blocks))]
+                 for _ in range(need - n_promoted - len(host_blocks))]
         for b in fresh:
             self._refs[b] = 1
             self._last_hit[b] = self.step_now
-        self._tables[seq_id] = matched + promo_blocks + host_blocks + fresh
+        self._tables[seq_id] = matched + mid_blocks + host_blocks + fresh
         self._lens[seq_id] = n
         self._tokens[seq_id] = list(tokens)
-        cached = min((len(matched) + len(promo_blocks) + len(host_blocks))
+        cached = min((len(matched) + len(chits) + len(host_blocks))
                      * bs, n - 1)
         self._committed[seq_id] = cached
-        if promo_blocks:
+        if chits:
             self.compress_hit_tokens += max(
-                0, min((len(matched) + len(promo_blocks)) * bs, cached)
+                0, min((len(matched) + len(chits)) * bs, cached)
                 - len(matched) * bs)
+        if n_direct:
+            self.direct_read_tokens += n_direct * bs
+            self._c_direct_toks.inc(n_direct * bs)
         if host_blocks:
-            tier_toks = max(0, cached - (len(matched) + len(promo_blocks))
+            tier_toks = max(0, cached - (len(matched) + len(chits))
                             * bs)
             self.tier_revivals += len(host_blocks)
             self.tier_hit_tokens += tier_toks
@@ -600,6 +669,15 @@ class PagedKVCache:
         bs = self.block_size
         for bi in range(start // bs, (max(end, start + 1) - 1) // bs + 1):
             old = table[bi]
+            if old < 0:
+                # unreachable by construction: writes land at positions
+                # >= cached, and alloc_sequence force-promotes the one
+                # compressed hit a capped full-prompt write can touch
+                # (the final block). Fail loudly rather than corrupt
+                # the shared int8 slot.
+                raise RuntimeError(
+                    f"copy-on-write reached int8-resident entry {old} "
+                    f"(seq {seq_id}, block index {bi})")
             if self._refs[old] <= 1:
                 continue
             if not self._free:
@@ -661,6 +739,8 @@ class PagedKVCache:
         toks = self._tokens[seq_id]
         for bi in range(self._committed[seq_id] // bs):
             block = table[bi]
+            if block < 0:
+                continue    # int8-resident: indexed by _cindex, not here
             if block in self._key_of:
                 continue                    # already indexed (maybe shared)
             key = tuple(toks[:(bi + 1) * bs])
@@ -731,7 +811,10 @@ class PagedKVCache:
             raise ValueError(f"sequence {dst_id} already allocated")
         table = self._tables[src_id]
         for b in table:
-            self._refs[b] += 1
+            if b < 0:       # shared direct-read slot: bump its pin too
+                self._cslot_refs[-b - 1] += 1
+            else:
+                self._refs[b] += 1
         self._tables[dst_id] = list(table)
         self._lens[dst_id] = self._lens[src_id]
         self._tokens[dst_id] = list(self._tokens[src_id])
@@ -764,6 +847,18 @@ class PagedKVCache:
         freed = 0
         freed_set = set()
         for b in blocks:
+            if b < 0:
+                # direct-read entry: unpin the int8 slot. The payload
+                # stays resident in _cindex (it never left), so there
+                # is no cached-free bookkeeping — the slot just becomes
+                # spillable again once its last reader drops.
+                slot = -b - 1
+                left = self._cslot_refs.get(slot, 0) - 1
+                if left > 0:
+                    self._cslot_refs[slot] = left
+                else:
+                    self._cslot_refs.pop(slot, None)
+                continue
             self._refs[b] -= 1
             if self._refs[b] == 0:
                 del self._refs[b]
@@ -857,6 +952,14 @@ class PagedKVCache:
         return self._compress_on
 
     @property
+    def direct_read_enabled(self) -> bool:
+        """Whether compressed hits are served in place by the mixed
+        ragged step (promote_hits != 1; 1 restores always-promote).
+        The scheduler's victim costing and the frontend's /kvprefixes
+        capability field branch on this."""
+        return self._compress_on and self.promote_hits != 1
+
+    @property
     def compressed_resident(self) -> int:
         return len(self._cindex)
 
@@ -906,6 +1009,8 @@ class PagedKVCache:
             out["promote_total"] = self.promoted_total
             out["compress_spills"] = self.compress_spills
             out["compress_hit_tokens"] = self.compress_hit_tokens
+            out["direct_int8_reads"] = self.direct_reads
+            out["direct_int8_tokens"] = self.direct_read_tokens
         if self.host_tier is not None:
             out["tier_revivals"] = self.tier_revivals
             out["tier_hit_tokens"] = self.tier_hit_tokens
@@ -918,6 +1023,7 @@ class PagedKVCache:
         self.tier_revivals = self.tier_hit_tokens = 0
         self.compressed_total = self.promoted_total = 0
         self.compress_spills = self.compress_hit_tokens = 0
+        self.direct_reads = self.direct_read_tokens = 0
 
     def assert_quiesced(self) -> None:
         """Leak check: with no live sequences every refcount must be
@@ -940,6 +1046,9 @@ class PagedKVCache:
             raise RuntimeError(
                 f"{len(self._pending_promotes)} promote lanes never "
                 "flushed")
+        if self._cslot_refs:
+            raise RuntimeError(
+                f"leaked direct-read slot pins: {self._cslot_refs}")
         if self._compress_on and \
                 len(self._cfree) + len(self._cindex) != self.compress_blocks:
             raise RuntimeError(

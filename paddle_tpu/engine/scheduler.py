@@ -338,7 +338,10 @@ class Scheduler:
         content entirely (without one, making it recompute-only), so
         the cheap credit is capped by free-slot capacity rather than
         handed to every committed block of an arbitrarily long
-        victim."""
+        victim. With direct reads (promote_hits != 1) the int8 rate
+        drops further: revival no longer pays the promote round-trip
+        (fp claim + dequantize scatter) — re-admission just bias-encodes
+        the resident slots into the new block table."""
         n = len(req.tokens)
         if self.cache.host_tier is None \
                 and not self.cache.compress_enabled:
@@ -350,9 +353,10 @@ class Scheduler:
                         self.cache.compress_free_slots
                         * self.cache.block_size)
             rest = full - cheap
+            rate = 0.1 if self.cache.direct_read_enabled else 0.25
             if self.cache.host_tier is not None:
-                return float(cheap * 0.25 + rest + tail * tail)
-            return float(cheap * 0.25 + rest * rest + tail * tail)
+                return float(cheap * rate + rest + tail * tail)
+            return float(cheap * rate + rest * rest + tail * tail)
         return float(full + tail * tail)
 
     def _pick_victim(self, keep: Request) -> Optional[Request]:

@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pltpu imports without TPU hardware; interpret mode needs no TPU.
@@ -51,6 +52,16 @@ from paddle_tpu.kernels.attention import reference_attention
 
 NEG_INF = -1e9
 LANES = 128   # online-softmax m/l scratch is lane-broadcast, as in flash.py
+# Mirror of quant.int8_compute's QMAX reciprocal (importing it would pull
+# nn.layers into the kernel module). The in-place dequant below must stay
+# bit-identical to dequantize_block: x = (q_int8 -> f32) * (scale * RQMAX),
+# then cast to the fp pool dtype — that identity is what makes direct int8
+# reads produce the same bytes as the promote-then-read path. Multiplying
+# by the pre-rounded reciprocal (rather than dividing by 127) keeps eager
+# and jitted dequant bit-equal: XLA rewrites constant division into
+# reciprocal multiplication, eager mode does not.
+_QMAX = 127.0
+_RQMAX = float(np.float32(1.0) / np.float32(_QMAX))
 
 
 @functools.lru_cache(maxsize=1)
@@ -310,10 +321,29 @@ def paged_attention(q, k_pool, v_pool, block_tables, context_lens,
 # ---------------------------------------------------------------------------
 
 
+def _gather_mixed(pool, q_pool, scales, ids, neg):
+    """Dense mixed-tier gather for the reference oracle: fp pool rows
+    where the (bias-decoded) table entry is non-negative, per-block
+    dequantized int8 rows where it is. ids: [...] raw table entries;
+    neg = ids < 0. Dequant is the dequantize_block identity —
+    (int8 -> f32) * (scale / QMAX), cast to the fp pool dtype — so a
+    direct read returns exactly the bytes a promote would have
+    scattered."""
+    fp_ids = jnp.where(neg, 0, ids)
+    q_ids = jnp.where(neg, -ids - 1, 0)
+    dense = pool[fp_ids]                       # [..., BS, Hkv, D]
+    deq = (q_pool[q_ids].astype(jnp.float32)
+           * (scales[q_ids] * _RQMAX)[..., None, None, None]
+           ).astype(pool.dtype)
+    return jnp.where(neg[..., None, None, None], deq, dense)
+
+
 def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
                                      context_lens, q_starts, tile_rows,
                                      tile_offs,
-                                     scale: Optional[float] = None):
+                                     scale: Optional[float] = None,
+                                     kq_pool=None, vq_pool=None,
+                                     k_scales=None, v_scales=None):
     """XLA oracle for the ragged layout: expand tile metadata to
     per-token rows and run the dense gather + masked attention.
     q: [T, H, D] flat-packed; returns [T, H, D].
@@ -321,7 +351,11 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
     Gathers [T, MB*BS, Hkv, D] — heavier than the per-row [B, ...]
     gathers above (every token re-gathers its row's blocks), but it is
     the off-TPU dispatch tier where T stays small (CPU smoke + tests),
-    and XLA's masked softmax keeps it exactly batch-invariant."""
+    and XLA's masked softmax keeps it exactly batch-invariant.
+
+    With kq_pool/vq_pool (+[NQ] per-block k_scales/v_scales) the table
+    entries are bias-encoded: id >= 0 reads the fp pool, id < 0 reads
+    int8 slot -id-1 and dequantizes in place."""
     t, h, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
     nt = tile_rows.shape[0]
@@ -332,14 +366,63 @@ def ragged_paged_attention_reference(q, k_pool, v_pool, block_tables,
     row_of = jnp.repeat(tile_rows, tq)                       # [T]
     qpos = (jnp.repeat(q_starts[tile_rows] + tile_offs, tq)
             + jnp.tile(jnp.arange(tq, dtype=jnp.int32), nt))  # [T]
-    k = k_pool[block_tables[row_of]].reshape(t, mb * bs, hkv, d)
-    v = v_pool[block_tables[row_of]].reshape(t, mb * bs, hkv, d)
+    bt = block_tables[row_of]                                # [T, MB]
+    if kq_pool is None:
+        k = k_pool[bt].reshape(t, mb * bs, hkv, d)
+        v = v_pool[bt].reshape(t, mb * bs, hkv, d)
+    else:
+        neg = bt < 0
+        k = _gather_mixed(k_pool, kq_pool, k_scales, bt, neg
+                          ).reshape(t, mb * bs, hkv, d)
+        v = _gather_mixed(v_pool, vq_pool, v_scales, bt, neg
+                          ).reshape(t, mb * bs, hkv, d)
     kv_pos = jnp.arange(mb * bs, dtype=jnp.int32)
     ctx = context_lens[row_of]
     mask = ((kv_pos[None, :] <= qpos[:, None])
             & (kv_pos[None, :] < ctx[:, None]))[:, None, None, :]
     return reference_attention(q[:, None].astype(k.dtype), k, v, mask=mask,
                                scale=scale)[:, 0].astype(q.dtype)
+
+
+def _ragged_tile_update(q, k, v, q0, ctx, j, m_scr, l_scr, acc_scr, *,
+                        scale: float, block_size: int, groups: int):
+    """Online-softmax update for one (query-tile, kv-block) cell —
+    shared by the fp-only and mixed-precision ragged kernels. q:
+    [TQ, H, D]; k/v: [BS, Hkv, D]; scratch rows are flattened TQ*H."""
+    tq, h, d = q.shape
+    hkv = k.shape[1]
+    # batch over kv heads: [Hkv, TQ*G, D] x [Hkv, BS, D]
+    qg = q.reshape(tq, hkv, groups, d).transpose(1, 0, 2, 3) \
+          .reshape(hkv, tq * groups, d)
+    kt = jnp.transpose(k, (1, 0, 2))                # [Hkv, BS, D]
+    s = jax.lax.dot_general(
+        qg, kt, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale  # [Hkv, TQ*G, BS]
+    s = s.reshape(hkv, tq, groups, block_size).transpose(1, 0, 2, 3) \
+         .reshape(tq * h, block_size)
+    qpos = q0 + jax.lax.broadcasted_iota(
+        jnp.int32, (tq, h, block_size), 0).reshape(tq * h, block_size)
+    kpos = j * block_size + jax.lax.broadcasted_iota(
+        jnp.int32, (tq, h, block_size), 2).reshape(tq * h, block_size)
+    s = jnp.where((kpos <= qpos) & (kpos < ctx), s, NEG_INF)
+
+    m_prev = m_scr[...][:, :1]                      # [TQ*H, 1]
+    l_prev = l_scr[...][:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)                          # [TQ*H, BS]
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    pg = p.reshape(tq, hkv, groups, block_size).transpose(1, 0, 2, 3) \
+          .reshape(hkv, tq * groups, block_size)
+    vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, BS, D]
+    pv = jax.lax.dot_general(
+        pg.astype(v.dtype), vt, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)         # [Hkv, TQ*G, D]
+    pv = pv.reshape(hkv, tq, groups, d).transpose(1, 0, 2, 3) \
+           .reshape(tq * h, d)
+    acc_scr[...] = alpha * acc_scr[...] + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
 
 def _ragged_kernel(bt_ref, cl_ref, qs_ref, tr_ref, to_ref,
@@ -365,43 +448,59 @@ def _ragged_kernel(bt_ref, cl_ref, qs_ref, tr_ref, to_ref,
     # causal future of the tile's LAST query (position q0 + tile_q - 1)
     @pl.when((j * block_size < ctx) & (j * block_size <= q0 + tile_q - 1))
     def _compute():
-        q = q_ref[...]                                  # [TQ, H, D]
-        k = k_ref[...]                                  # [BS, Hkv, D]
-        v = v_ref[...]
-        tq, h, d = q.shape
-        hkv = k.shape[1]
-        # batch over kv heads: [Hkv, TQ*G, D] x [Hkv, BS, D]
-        qg = q.reshape(tq, hkv, groups, d).transpose(1, 0, 2, 3) \
-              .reshape(hkv, tq * groups, d)
-        kt = jnp.transpose(k, (1, 0, 2))                # [Hkv, BS, D]
-        s = jax.lax.dot_general(
-            qg, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * scale  # [Hkv, TQ*G, BS]
-        s = s.reshape(hkv, tq, groups, block_size).transpose(1, 0, 2, 3) \
-             .reshape(tq * h, block_size)
-        qpos = q0 + jax.lax.broadcasted_iota(
-            jnp.int32, (tq, h, block_size), 0).reshape(tq * h, block_size)
-        kpos = j * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, (tq, h, block_size), 2).reshape(tq * h, block_size)
-        s = jnp.where((kpos <= qpos) & (kpos < ctx), s, NEG_INF)
+        _ragged_tile_update(q_ref[...], k_ref[...], v_ref[...], q0, ctx, j,
+                            m_scr, l_scr, acc_scr, scale=scale,
+                            block_size=block_size, groups=groups)
 
-        m_prev = m_scr[...][:, :1]                      # [TQ*H, 1]
-        l_prev = l_scr[...][:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                          # [TQ*H, BS]
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        pg = p.reshape(tq, hkv, groups, block_size).transpose(1, 0, 2, 3) \
-              .reshape(hkv, tq * groups, block_size)
-        vt = jnp.transpose(v, (1, 0, 2))                # [Hkv, BS, D]
-        pv = jax.lax.dot_general(
-            pg.astype(v.dtype), vt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)         # [Hkv, TQ*G, D]
-        pv = pv.reshape(hkv, tq, groups, d).transpose(1, 0, 2, 3) \
-               .reshape(tq * h, d)
-        acc_scr[...] = alpha * acc_scr[...] + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+    @pl.when(j == nblk - 1)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        o_ref[...] = (acc_scr[...] / jnp.maximum(l, 1e-30)).reshape(
+            o_ref.shape).astype(o_ref.dtype)
+
+
+def _ragged_kernel_mixed(bt_ref, cl_ref, qs_ref, tr_ref, to_ref,
+                         ksc_ref, vsc_ref,
+                         q_ref, k_ref, v_ref, kq_ref, vq_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *,
+                         scale: float, block_size: int, tile_q: int,
+                         groups: int):
+    """Mixed-precision variant: the block table entry is bias-encoded
+    (id >= 0 -> fp pool block id; id < 0 -> int8 pool slot -id-1). Both
+    pools ride their own BlockSpec — each index map degenerates to slot
+    0 for the tier it does NOT serve, so only the selected tier's DMA
+    changes block-to-block — and the kernel dequantizes the int8 block
+    in registers with the per-block scale from scalar prefetch. The
+    dequant is bit-identical to quant.dequantize_block, which is what
+    pins direct-read output to the promote path's bytes."""
+    t, j = pl.program_id(0), pl.program_id(1)
+    nblk = pl.num_programs(1)
+    row = tr_ref[t]
+    ctx = cl_ref[row]
+    q0 = qs_ref[row] + to_ref[t]
+    e = bt_ref[row, j]
+    is8 = e < 0
+    slot = jnp.where(is8, -e - 1, 0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when((j * block_size < ctx) & (j * block_size <= q0 + tile_q - 1))
+    def _compute():
+        kf = k_ref[...]                                 # [BS, Hkv, D]
+        vf = v_ref[...]
+        kd = (kq_ref[...].astype(jnp.float32)
+              * (ksc_ref[slot] * _RQMAX)).astype(kf.dtype)
+        vd = (vq_ref[...].astype(jnp.float32)
+              * (vsc_ref[slot] * _RQMAX)).astype(vf.dtype)
+        k = jnp.where(is8, kd, kf)
+        v = jnp.where(is8, vd, vf)
+        _ragged_tile_update(q_ref[...], k, v, q0, ctx, j,
+                            m_scr, l_scr, acc_scr, scale=scale,
+                            block_size=block_size, groups=groups)
 
     @pl.when(j == nblk - 1)
     def _finalize():
@@ -412,7 +511,9 @@ def _ragged_kernel(bt_ref, cl_ref, qs_ref, tr_ref, to_ref,
 
 def _ragged_kernel_call(q, k_pool, v_pool, block_tables, context_lens,
                         q_starts, tile_rows, tile_offs, scale,
-                        interpret: bool):
+                        interpret: bool,
+                        kq_pool=None, vq_pool=None,
+                        k_scales=None, v_scales=None):
     t, h, d = q.shape
     nb, bs, hkv, _ = k_pool.shape
     mb = block_tables.shape[1]
@@ -422,70 +523,127 @@ def _ragged_kernel_call(q, k_pool, v_pool, block_tables, context_lens,
     tq = t // nt
     if h % hkv:
         raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    mixed = kq_pool is not None
+
+    def _active(ti, j, cl, qs, tr, to):
+        # skip predicate shared by every kv index map: inactive cells
+        # re-select block 0, which elides the DMA entirely when the
+        # previous cell already holds it (Pallas skips re-fetch on an
+        # unchanged block index)
+        row = tr[ti]
+        return ((j * bs < cl[row])
+                & (j * bs <= qs[row] + to[ti] + tq - 1))
 
     def _kv_block(ti, j, bt, cl, qs, tr, to):
-        # index-map gather WITH skip: inactive cells re-select block 0,
-        # which elides the DMA entirely when the previous cell already
-        # holds it (Pallas skips re-fetch on an unchanged block index)
-        row = tr[ti]
-        active = ((j * bs < cl[row])
-                  & (j * bs <= qs[row] + to[ti] + tq - 1))
-        return (jnp.where(active, bt[row, j], 0), 0, 0, 0)
+        return (jnp.where(_active(ti, j, cl, qs, tr, to),
+                          bt[tr[ti], j], 0), 0, 0, 0)
+
+    def _kv_fp(ti, j, bt, cl, qs, tr, to, ksc, vsc):
+        # bias-encoded entry: only non-negative ids live in the fp pool
+        e = bt[tr[ti], j]
+        act = _active(ti, j, cl, qs, tr, to) & (e >= 0)
+        return (jnp.where(act, e, 0), 0, 0, 0)
+
+    def _kv_q(ti, j, bt, cl, qs, tr, to, ksc, vsc):
+        # negative ids decode to int8 pool slot -id-1
+        e = bt[tr[ti], j]
+        act = _active(ti, j, cl, qs, tr, to) & (e < 0)
+        return (jnp.where(act, -e - 1, 0), 0, 0, 0)
+
+    if mixed:
+        def _q_map(ti, j, bt, cl, qs, tr, to, ksc, vsc):
+            return (ti, 0, 0)
+        # block_tables, ctx_lens, q_starts, tiles x2, k/v scales
+        num_prefetch = 7
+        in_specs = [
+            pl.BlockSpec((tq, h, d), _q_map),
+            pl.BlockSpec((None, bs, hkv, d), _kv_fp),
+            pl.BlockSpec((None, bs, hkv, d), _kv_fp),
+            pl.BlockSpec((None, bs, hkv, d), _kv_q),
+            pl.BlockSpec((None, bs, hkv, d), _kv_q),
+        ]
+        out_specs = pl.BlockSpec((tq, h, d), _q_map)
+        kernel_fn = _ragged_kernel_mixed
+    else:
+        def _q_map(ti, j, bt, cl, qs, tr, to):
+            return (ti, 0, 0)
+        num_prefetch = 5  # block_tables, ctx_lens, q_starts, tiles x2
+        in_specs = [
+            pl.BlockSpec((tq, h, d), _q_map),
+            pl.BlockSpec((None, bs, hkv, d), _kv_block),
+            pl.BlockSpec((None, bs, hkv, d), _kv_block),
+        ]
+        out_specs = pl.BlockSpec((tq, h, d), _q_map)
+        kernel_fn = _ragged_kernel
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,  # block_tables, ctx_lens, q_starts, tiles x2
+        num_scalar_prefetch=num_prefetch,
         grid=(nt, mb),
-        in_specs=[
-            pl.BlockSpec((tq, h, d),
-                         lambda ti, j, bt, cl, qs, tr, to: (ti, 0, 0)),
-            pl.BlockSpec((None, bs, hkv, d), _kv_block),
-            pl.BlockSpec((None, bs, hkv, d), _kv_block),
-        ],
-        out_specs=pl.BlockSpec((tq, h, d),
-                               lambda ti, j, bt, cl, qs, tr, to: (ti, 0, 0)),
+        in_specs=in_specs,
+        out_specs=out_specs,
         scratch_shapes=[
             _scratch((tq * h, LANES)),
             _scratch((tq * h, LANES)),
             _scratch((tq * h, d)),
         ],
     )
-    kernel = functools.partial(_ragged_kernel, scale=scale, block_size=bs,
+    kernel = functools.partial(kernel_fn, scale=scale, block_size=bs,
                                tile_q=tq, groups=h // hkv)
     compiler_params = None
     if pltpu is not None:
         cls = (getattr(pltpu, "CompilerParams", None)
                or pltpu.TPUCompilerParams)
         compiler_params = cls(dimension_semantics=("parallel", "arbitrary"))
-    return pl.pallas_call(
+    call = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, h, d), q.dtype),
         compiler_params=compiler_params,
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-      q_starts.astype(jnp.int32), tile_rows.astype(jnp.int32),
-      tile_offs.astype(jnp.int32), q, k_pool, v_pool)
+    )
+    scalars = (block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+               q_starts.astype(jnp.int32), tile_rows.astype(jnp.int32),
+               tile_offs.astype(jnp.int32))
+    if mixed:
+        return call(*scalars, k_scales.astype(jnp.float32),
+                    v_scales.astype(jnp.float32),
+                    q, k_pool, v_pool, kq_pool, vq_pool)
+    return call(*scalars, q, k_pool, v_pool)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, block_tables, context_lens,
                            q_starts, tile_rows, tile_offs,
                            scale: Optional[float] = None,
                            use_kernel: Optional[bool] = None,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           kq_pool=None, vq_pool=None,
+                           k_scales=None, v_scales=None):
     """Mixed prefill+decode attention over the flat ragged packing —
     the engine's single-step entry point. Dispatch tiers mirror
     paged_attention: Pallas kernel on TPU, XLA reference elsewhere,
-    PTPU_PAGED_KERNEL / explicit flags override."""
+    PTPU_PAGED_KERNEL / explicit flags override.
+
+    When the engine's compressed tier is live it passes the int8 pools
+    (kq_pool/vq_pool [NQ, BS, Hkv, D]) and per-block scales ([NQ] f32),
+    and bias-encodes int8-resident blocks into block_tables (id < 0 ->
+    slot -id-1): those blocks are read in place — dequantized per block
+    inside the gather — instead of being promoted to fp first. The
+    signature is shape-stable across fp-only / mixed / all-int8 batches
+    so the jit cache stays at one entry (TP004)."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
     use_kernel, interpret = _resolve_dispatch(use_kernel, interpret)
     if not use_kernel:
         return ragged_paged_attention_reference(
             q, k_pool, v_pool, block_tables, context_lens, q_starts,
-            tile_rows, tile_offs, scale=scale)
+            tile_rows, tile_offs, scale=scale,
+            kq_pool=kq_pool, vq_pool=vq_pool,
+            k_scales=k_scales, v_scales=v_scales)
     return _ragged_kernel_call(q, k_pool, v_pool, block_tables,
                                context_lens, q_starts, tile_rows, tile_offs,
-                               scale, interpret)
+                               scale, interpret,
+                               kq_pool=kq_pool, vq_pool=vq_pool,
+                               k_scales=k_scales, v_scales=v_scales)
 
 
 # -- tensor-parallel wrappers (engine tp_size knob, ENGINE.md) ------------
@@ -505,29 +663,52 @@ def ragged_paged_attention_tp(mesh, q, k_pool, v_pool, block_tables,
                               context_lens, q_starts, tile_rows, tile_offs,
                               scale: Optional[float] = None,
                               use_kernel: Optional[bool] = None,
-                              interpret: Optional[bool] = None):
+                              interpret: Optional[bool] = None,
+                              kq_pool=None, vq_pool=None,
+                              k_scales=None, v_scales=None):
     """`ragged_paged_attention` as an explicit shard_map island over
     the "tp" axis of `mesh` — q [T, H, D] sharded on H, pools sharded
     on Hkv, everything else replicated; output [T, H, D] stays sharded
     on H (the downstream out_proj is row-parallel over the same
-    axis)."""
+    axis). The int8 pools shard on Hkv exactly like the fp pools;
+    per-block scales are head-independent scalars, replicated."""
     from jax.sharding import PartitionSpec as P
 
     from paddle_tpu.parallel.compat import shard_map
 
-    def body(q_, kp, vp, bt, cl, qs, tr, to):
+    if kq_pool is None:
+        def body(q_, kp, vp, bt, cl, qs, tr, to):
+            return ragged_paged_attention(q_, kp, vp, bt, cl, qs, tr, to,
+                                          scale=scale, use_kernel=use_kernel,
+                                          interpret=interpret)
+
+        f = shard_map(body, mesh=mesh,
+                      in_specs=(P(None, "tp", None),
+                                P(None, None, "tp", None),
+                                P(None, None, "tp", None),
+                                P(), P(), P(), P(), P()),
+                      out_specs=P(None, "tp", None), check_vma=False)
+        return f(q, k_pool, v_pool, block_tables, context_lens, q_starts,
+                 tile_rows, tile_offs)
+
+    def body(q_, kp, vp, bt, cl, qs, tr, to, kq, vq, ks, vs):
         return ragged_paged_attention(q_, kp, vp, bt, cl, qs, tr, to,
                                       scale=scale, use_kernel=use_kernel,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      kq_pool=kq, vq_pool=vq,
+                                      k_scales=ks, v_scales=vs)
 
     f = shard_map(body, mesh=mesh,
                   in_specs=(P(None, "tp", None),
                             P(None, None, "tp", None),
                             P(None, None, "tp", None),
-                            P(), P(), P(), P(), P()),
+                            P(), P(), P(), P(), P(),
+                            P(None, None, "tp", None),
+                            P(None, None, "tp", None),
+                            P(), P()),
                   out_specs=P(None, "tp", None), check_vma=False)
     return f(q, k_pool, v_pool, block_tables, context_lens, q_starts,
-             tile_rows, tile_offs)
+             tile_rows, tile_offs, kq_pool, vq_pool, k_scales, v_scales)
 
 
 def paged_prefill_attention_tp(mesh, q, k_pool, v_pool, block_tables,

@@ -268,7 +268,7 @@ class MultiHeadAttention(Module):
 
     def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots, tp=None):
+                          tile_offs, slots, tp=None, qpool=None):
         """Mixed prefill+decode step over the FLAT ragged packing
         (kernels/paged_attention.py ragged_paged_attention): x: [T, D]
         — decode rows and prefill chunks packed into tile-aligned
@@ -276,7 +276,11 @@ class MultiHeadAttention(Module):
         pool at `slots` [T] first (pad positions land in scratch
         block 0), then one attention launch serves every row. Returns
         (out [T, D], (new_k_pool, new_v_pool)). `tp` routes attention
-        through the sharded island (see prefill_chunk_paged)."""
+        through the sharded island (see prefill_chunk_paged).
+        `qpool` = (kq, vq, k_scales, v_scales) threads this layer's
+        int8 compressed tier into the launch: bias-encoded (negative)
+        block-table entries read it in place. Writes always target the
+        fp pool — slots never point at int8 blocks."""
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         t = x.shape[0]
         if self.fused_qkv:
@@ -297,14 +301,19 @@ class MultiHeadAttention(Module):
         v_pool = v_pool.reshape(flat).at[slots].set(
             vh.astype(v_pool.dtype)).reshape(v_pool.shape)
         from paddle_tpu.kernels import paged_attention as paged
+        kq, vq, ksc, vsc = qpool if qpool is not None else (None,) * 4
         if tp is not None:
             out = paged.ragged_paged_attention_tp(
                 tp.mesh, qh, k_pool, v_pool, block_tables, context_lens,
-                q_starts, tile_rows, tile_offs)            # [T, H, hd]
+                q_starts, tile_rows, tile_offs,
+                kq_pool=kq, vq_pool=vq,
+                k_scales=ksc, v_scales=vsc)                # [T, H, hd]
         else:
             out = paged.ragged_paged_attention(
                 qh, k_pool, v_pool, block_tables, context_lens, q_starts,
-                tile_rows, tile_offs)                      # [T, H, hd]
+                tile_rows, tile_offs,
+                kq_pool=kq, vq_pool=vq,
+                k_scales=ksc, v_scales=vsc)                # [T, H, hd]
         out = self.out_proj(cx, out.reshape(t, self.model_dim))
         return out, (k_pool, v_pool)
 
@@ -576,11 +585,12 @@ class CausalBlock(Module):
 
     def ragged_step_paged(self, cx: Context, x, k_pool, v_pool,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots, tp=None):
+                          tile_offs, slots, tp=None, qpool=None):
         cx = cx.scope(self._name or type(self).__name__)  # see attend()
         h, pools = self.attn.ragged_step_paged(
             cx, self.ln1(cx, x), k_pool, v_pool, block_tables,
-            context_lens, q_starts, tile_rows, tile_offs, slots, tp=tp)
+            context_lens, q_starts, tile_rows, tile_offs, slots, tp=tp,
+            qpool=qpool)
         x = x + self.drop(cx, h)
         f = (self.ffn.forward_serve_tp(cx, self.ln2(cx, x), tp)
              if tp is not None else self.ffn(cx, self.ln2(cx, x)))
@@ -756,7 +766,8 @@ class CausalLM(Module):
 
     def ragged_step_paged(self, cx: Context, tokens, positions, pools,
                           block_tables, context_lens, q_starts, tile_rows,
-                          tile_offs, slots, last_idx, tp=None):
+                          tile_offs, slots, last_idx, tp=None,
+                          qpools=None, qscales=None):
         """ONE mixed prefill+decode serve step over the flat ragged
         packing — the engine's single compiled path. tokens [T] ids and
         positions [T] int32 are the flat packing (decode rows are
@@ -772,17 +783,26 @@ class CausalLM(Module):
         every draft position from the same launch; non-speculative rows
         just repeat their single real index across the S columns). The
         engine samples only the rows whose window ended a prompt or
-        decoded a token."""
+        decoded a token. With qpools/qscales (the engine's in-device
+        compressed tier; empty lists when compression is off) each
+        layer's int8 pool + per-block scales join its attention launch,
+        and bias-encoded block-table entries read them in place."""
         x = self.embed(cx, tokens) * math.sqrt(self.model_dim)   # [T, D]
         pe = sinusoid_position_encoding(self.max_len, self.model_dim)
         pos_safe = jnp.clip(positions.astype(jnp.int32), 0, self.max_len - 1)
         x = x + pe[pos_safe].astype(x.dtype)
         new_pools = []
-        for blk, (k_pool, v_pool) in zip(self.blocks, pools):
+        for li, (blk, (k_pool, v_pool)) in enumerate(zip(self.blocks,
+                                                         pools)):
+            qpool = None
+            if qpools:
+                kq, vq = qpools[li]
+                ksc, vsc = qscales[li]
+                qpool = (kq, vq, ksc, vsc)
             x, np_ = blk.ragged_step_paged(cx, x, k_pool, v_pool,
                                            block_tables, context_lens,
                                            q_starts, tile_rows, tile_offs,
-                                           slots, tp=tp)
+                                           slots, tp=tp, qpool=qpool)
             new_pools.append(np_)
         hidden = self.ln_f(cx, x)                                # [T, D]
         idx = last_idx.astype(jnp.int32)

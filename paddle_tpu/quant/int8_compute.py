@@ -277,6 +277,16 @@ def freeze_int8(module: Module, variables: Variables, calib_batches=None
 #: host tier, wire, device pool — stay interchangeable.
 KV_SCALE_FLOOR = 1e-30
 
+#: f32 reciprocal of QMAX, rounded once. Dequant multiplies by
+#: `scale * RQMAX` instead of dividing by QMAX: XLA rewrites division
+#: by a constant into multiplication by its rounded reciprocal, so a
+#: jitted `s / QMAX` and an eager one differ by 1 ulp. Spelling the
+#: reciprocal out makes every dequant site — eager promote flush,
+#: jitted promote lanes, the mixed ragged kernel's in-register dequant
+#: — produce byte-identical fp, which is what lets the direct-read
+#: step reproduce the promote path's output bit-for-bit.
+RQMAX = float(np.float32(1.0) / np.float32(QMAX))
+
 
 def quantize_block(x):
     """jit-safe per-block symmetric abs-max int8 quantization on
@@ -300,9 +310,11 @@ def dequantize_block(q, scale, dtype):
     """Inverse of quantize_block (device side): max abs error is
     scale / QMAX per element — one quantization step, the same bound
     the host tier documents. `scale` broadcasts over the trailing
-    three axes (scalar for one block, [lanes] for a lane batch)."""
+    three axes (scalar for one block, [lanes] for a lane batch). The
+    factor is `scale * RQMAX` (see RQMAX) so eager and jitted dequant
+    — and the ragged kernel's in-register dequant — agree bit-for-bit."""
     s = jnp.asarray(scale, jnp.float32)[..., None, None, None]
-    return (q.astype(jnp.float32) * (s / QMAX)).astype(dtype)
+    return (q.astype(jnp.float32) * (s * RQMAX)).astype(dtype)
 
 def quantize_host_int8(x: np.ndarray) -> Tuple[np.ndarray, float]:
     """Per-tensor symmetric abs-max int8 quantization on the host.

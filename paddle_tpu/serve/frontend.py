@@ -746,10 +746,16 @@ class ServeFrontend:
         """The /kvprefixes body: this replica's warm-prefix
         advertisement for the router's fleet prefix directory, plus its
         serving phase (argv-seeded replicas never POST /register, so
-        the phase has to ride the scrape)."""
+        the phase has to ride the scrape). `direct_int8` advertises the
+        mixed-step direct-read capability: with it the router prices
+        this replica's device_int8 rows like device-fp rows (no promote
+        round-trip on a hit); older replicas never send the field and
+        keep the old ordering."""
         with self._lock:
             return {"prefixes": list(self._directory),
-                    "phase": self.phase}
+                    "phase": self.phase,
+                    "direct_int8": bool(getattr(self.engine,
+                                                "kv_direct_int8", False))}
 
     def _debug_payload(self) -> dict:
         """The /debug body: the engine-loop-refreshed scheduler/KV

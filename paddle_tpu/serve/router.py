@@ -193,7 +193,10 @@ def prefix_digest(tokens: Sequence[int]) -> str:
 # directory tier ranking: a device-resident fp prefix serves with zero
 # copies, a device-int8 one needs only an on-device dequantize promotion
 # (no DMA), a host-tier one needs a DMA revival, anything else
-# re-prefills
+# re-prefills. A replica advertising the direct_int8 capability on
+# /kvprefixes reads int8 blocks in place — no promote at all — so
+# _directory_best re-prices ITS device_int8 rows up to the device rank;
+# the table itself keeps the legacy ordering for older replicas.
 _TIER_RANK = {"device": 2, "device_int8": 1, "host": 0}
 
 # breaker state as a gauge level (ptpu_router_breaker_state)
@@ -243,7 +246,7 @@ class ReplicaState:
     __slots__ = ("url", "host", "port", "ready", "reason", "hit_rate",
                  "queue_depth", "last_scrape", "prefixes", "fails",
                  "breaker", "open_until", "ttft_p95_ms", "registered",
-                 "scraping", "phase", "burning")
+                 "scraping", "phase", "burning", "direct_int8")
 
     def __init__(self, url: str):
         parts = urlsplit(url)
@@ -271,6 +274,9 @@ class ReplicaState:
         # SLO objectives the replica itself reports as burning
         # (ptpu_slo_burning gauges at 1.0) — fleet admission's input
         self.burning: Tuple[str, ...] = ()
+        # mixed-step direct-read capability from /kvprefixes: int8
+        # prefix rows on this replica serve without a promote
+        self.direct_int8 = False
 
 
 class _RelayState:
@@ -562,6 +568,7 @@ class Router:
         vals = {}
         prefixes: Dict[Tuple[int, str], str] = {}
         phase: Optional[str] = None
+        direct_int8 = False
         try:
             conn = HTTPConnection(r.host, r.port,
                                   timeout=self.scrape_timeout_s)
@@ -587,12 +594,16 @@ class Router:
                         # seeded replicas never POST /register
                         if payload.get("phase") in _PHASE_LEVEL:
                             phase = payload["phase"]
+                        # capability field (absent on older replicas)
+                        direct_int8 = bool(payload.get("direct_int8",
+                                                       False))
                         for row in payload.get("prefixes", []):
                             prefixes[(int(row["len"]),
                                       str(row["digest"]))] = \
                                 str(row.get("tier", "device"))
                     except (ValueError, KeyError, TypeError):
                         prefixes = {}
+                        direct_int8 = False
             finally:
                 conn.close()
             vals = parse_prometheus_values(text)
@@ -618,6 +629,7 @@ class Router:
             r.reason = reason
             r.prefixes = prefixes
             r.burning = burning
+            r.direct_int8 = direct_int8
             if phase is not None:
                 r.phase = phase
             phase_pub = r.phase
@@ -711,15 +723,21 @@ class Router:
         at the HOTTEST tier plus that matched length, or (None, 0) when
         the fleet directory has no match. Digests are memoized per
         length: one crc32 per distinct advertised prefix length, not
-        per (replica, row)."""
+        per (replica, row). A direct_int8-capable replica's device_int8
+        rows rank AT the device rung — its mixed step reads them in
+        place, no promote — while replicas without the capability keep
+        the legacy device > device_int8 > host ordering."""
         best: Optional[ReplicaState] = None
         best_score = (-1, -1)
         memo: Dict[int, str] = {}
-        for r, (ready, _, _, prefixes, _, _) in snapshot.items():
+        for r, (ready, _, _, prefixes, _, _, direct) in snapshot.items():
             if not ready:
                 continue
             for (ln, dg), tier in prefixes.items():
-                score = (ln, _TIER_RANK.get(tier, -1))
+                rank = (_TIER_RANK["device"]
+                        if direct and tier == "device_int8"
+                        else _TIER_RANK.get(tier, -1))
+                score = (ln, rank)
                 if ln > len(prompt) or score <= best_score:
                     continue
                 if ln not in memo:
@@ -769,7 +787,8 @@ class Router:
         dir_pick (serve/kvxfer.py)."""
         with self._lock:    # one consistent snapshot to rank against
             stats = {r: (r.ready, r.hit_rate, r.queue_depth,
-                         dict(r.prefixes), r.breaker, r.phase)
+                         dict(r.prefixes), r.breaker, r.phase,
+                         r.direct_int8)
                      for r in self.replicas}
         members = list(stats.keys())
         if not members:

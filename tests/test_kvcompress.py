@@ -214,9 +214,12 @@ TAILS = [[21, 22, 23, 24], [31, 32, 33, 34], [41, 42, 43, 44]]
 def test_compress_promote_identity(model_and_vars):
     """Warm-up, churn until the fp copies are evicted but the int8
     copies survive, then resubmit: the promoted prefix must reproduce
-    the cold run's greedy output, on the ONE compiled step."""
+    the cold run's greedy output, on the ONE compiled step.
+    kv_promote_hits=1 is the legacy always-promote mode; the default
+    (0) serves compressed hits in place — see the direct-read tests."""
     model, variables = model_and_vars
-    eng = _engine(model, variables, kv_compress_blocks=24)
+    eng = _engine(model, variables, kv_compress_blocks=24,
+                  kv_promote_hits=1)
     prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]
     cold = eng.generate([prompt], max_new_tokens=6)
     eng.generate([[50] * 8], max_new_tokens=8)         # lets prompt idle
@@ -233,6 +236,156 @@ def test_compress_promote_identity(model_and_vars):
     assert eng.obs.get("ptpu_kv_promote_total").value == st["promote_total"]
     assert eng._step_fn._cache_size() == 1
     eng.cache.assert_quiesced()
+
+
+def test_direct_read_serves_in_place(model_and_vars):
+    """Default mode (kv_promote_hits=0): a prefix hit on a
+    compressed-only block is served by the mixed step reading the int8
+    slot in place — NO fp claim, NO promote staging — and reproduces
+    the cold run's greedy output on the ONE compiled step. The prompt
+    length is off block stride so no matched block is the final one
+    (a full-prompt final-block hit still force-promotes: the last
+    token's write needs a writable fp block)."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=24)
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8, 6, 2]
+    cold = eng.generate([prompt], max_new_tokens=6)
+    eng.generate([[50] * 8], max_new_tokens=8)         # lets prompt idle
+    for i in range(3):                                 # evict fp copies
+        eng.generate([[30 + i] * 16], max_new_tokens=12)
+    bs = eng.cache.block_size
+    assert tuple(prompt[:bs]) not in eng.cache._index  # fp copy gone
+    assert tuple(prompt[:bs]) in eng.cache._cindex     # int8 copy alive
+    warm = eng.generate([prompt], max_new_tokens=6)
+    assert warm == cold
+    st = eng.cache.stats()
+    assert st["promote_total"] == 0
+    assert st["direct_int8_reads"] == 3                # 3 full blocks hit
+    assert st["direct_int8_tokens"] == 3 * bs
+    assert eng.obs.get("ptpu_kv_direct_int8_reads_total").value == 3
+    assert eng.obs.get("ptpu_kv_direct_int8_tokens_total").value == 3 * bs
+    assert eng.cache.stats()["compress_hit_tokens"] > 0
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+def test_direct_read_output_matches_promote_path(model_and_vars):
+    """THE acceptance bar: identical traffic through a direct-read
+    engine and a legacy always-promote engine produces byte-identical
+    outputs — the in-kernel dequant IS dequantize_block."""
+    model, variables = model_and_vars
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8, 6, 2]
+    outs = []
+    for hits in (0, 1):
+        eng = _engine(model, variables, kv_compress_blocks=24,
+                      kv_promote_hits=hits)
+        o = [eng.generate([prompt], max_new_tokens=6)]
+        eng.generate([[50] * 8], max_new_tokens=8)
+        for i in range(3):
+            o.append(eng.generate([[30 + i] * 16], max_new_tokens=12))
+        o.append(eng.generate([prompt], max_new_tokens=6))
+        outs.append(o)
+        st = eng.cache.stats()
+        if hits == 0:
+            assert st["promote_total"] == 0
+            assert st["direct_int8_reads"] > 0
+        else:
+            assert st["promote_total"] > 0
+            assert st["direct_int8_reads"] == 0
+        eng.cache.assert_quiesced()
+    assert outs[0] == outs[1]
+
+
+def test_full_prompt_hit_promotes_final_block(model_and_vars):
+    """A prompt whose every block is compressed-resident still runs:
+    the final matched block takes the last token's write, so it
+    promotes to fp while the earlier blocks direct-read."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=24)
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8]    # 3 exact blocks
+    cold = eng.generate([prompt], max_new_tokens=6)
+    eng.generate([[50] * 8], max_new_tokens=8)
+    for i in range(3):
+        eng.generate([[30 + i] * 16], max_new_tokens=12)
+    warm = eng.generate([prompt], max_new_tokens=6)
+    assert warm == cold
+    st = eng.cache.stats()
+    assert st["promote_total"] == 1 and st["direct_int8_reads"] == 2
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+def test_precision_churn_keeps_one_compiled_step(model_and_vars):
+    """kv_promote_hits=2 is the warm-up ladder: the first re-request
+    direct-reads (1 hit < 2), the second promotes back to fp — blocks
+    migrate fp -> int8 -> fp mid-stream. Every rung returns the cold
+    output and the jit cache never leaves 1."""
+    model, variables = model_and_vars
+    eng = _engine(model, variables, kv_compress_blocks=24,
+                  kv_promote_hits=2)
+    prompt = [7, 3, 7, 3, 11, 2, 5, 9, 1, 1, 4, 8, 6, 2]
+    cold = eng.generate([prompt], max_new_tokens=6)
+
+    def churn():
+        # off block stride so the churn prompts' own re-hits stay
+        # direct reads (a full-prompt hit would force-promote its
+        # final block and muddy the promote counts below)
+        eng.generate([[50] * 9], max_new_tokens=8)
+        for i in range(3):
+            eng.generate([[30 + i] * 15], max_new_tokens=12)
+
+    churn()
+    warm1 = eng.generate([prompt], max_new_tokens=6)   # direct read
+    st = eng.cache.stats()
+    assert warm1 == cold
+    assert st["direct_int8_reads"] == 3 and st["promote_total"] == 0
+    churn()
+    warm2 = eng.generate([prompt], max_new_tokens=6)   # hits=2: promote
+    st = eng.cache.stats()
+    assert warm2 == cold
+    assert st["promote_total"] == 3
+    warm3 = eng.generate([prompt], max_new_tokens=6)   # fp again
+    assert warm3 == cold
+    assert eng._step_fn._cache_size() == 1
+    eng.cache.assert_quiesced()
+
+
+def test_cache_direct_alloc_pins_and_frees_slots():
+    """Cache-level direct admission: matched compressed blocks land in
+    the table bias-encoded (-slot-1), pin their slots against spill,
+    survive a fork, and unpin on free."""
+    c = _cache(compress_blocks=8)
+    toks = list(range(10))
+    c.alloc_sequence(1, toks)
+    c.commit_prefill(1, 10)
+    c.free_sequence(1)
+    c.step_now = 10
+    assert c.compress_cold(idle_steps=4) == 2
+    c.drain_compress()
+    # churn the fp copies out so the int8 copies are the only residents
+    # (4 x 4 blocks > the 13 never-used blocks: the LRU cached-free fp
+    # copies — seq 1's — get evicted)
+    for s, base in ((2, 100), (3, 200), (4, 300), (5, 400)):
+        c.alloc_sequence(s, [base + i for i in range(16)])
+        c.commit_prefill(s, 16)
+        c.free_sequence(s)
+    assert tuple(toks[:4]) not in c._index
+    n = c.alloc_sequence(9, toks)
+    assert n == 8                        # both full blocks served cached
+    table = c.block_table(9)
+    assert table[0] < 0 and table[1] < 0 and table[2] >= 0
+    assert c.stats()["direct_int8_reads"] == 2
+    assert c.stats()["promote_total"] == 0
+    slots = {-b - 1 for b in table[:2]}
+    assert all(c._cslot_refs[s] == 1 for s in slots)
+    c.fork_sequence(9, 10)
+    assert all(c._cslot_refs[s] == 2 for s in slots)
+    c.free_sequence(9)
+    assert all(c._cslot_refs[s] == 1 for s in slots)
+    c.free_sequence(10)
+    assert not c._cslot_refs
+    c.drain_compress()       # lanes staged by churn evictions
+    c.assert_quiesced()
 
 
 def test_preempt_compress_revive_completes(model_and_vars):
@@ -331,3 +484,48 @@ def test_router_ranks_device_over_int8_over_host():
     # longest match still beats a hotter shorter one
     a.prefixes = {(12, prefix_digest(prompt)): "host"}
     assert router.plan_route(prompt)[0] is a
+
+
+def test_router_reprices_int8_for_direct_capable_replica():
+    """A replica that advertises direct_int8 reads its device_int8
+    rows in place — the router prices them AT the device rung: they
+    beat a non-capable replica's device_int8 rows and tie device-fp
+    rows (ties keep the earlier replica). Replicas that never sent the
+    field keep the legacy device > device_int8 > host ordering."""
+    urls = [f"http://127.0.0.1:{9200 + i}" for i in range(3)]
+    router = Router(urls, enable_directory=True)
+    a, b, c = router.replicas
+    for r in router.replicas:
+        r.ready = True
+    prompt = list(range(12))
+    d8 = prefix_digest(prompt[:8])
+    row = {(8, d8): "device_int8"}
+    # capable int8 beats non-capable int8, in either scan order
+    a.prefixes, b.prefixes = dict(row), dict(row)
+    b.direct_int8 = True
+    assert router.plan_route(prompt)[0] is b
+    a.direct_int8, b.direct_int8 = True, False
+    assert router.plan_route(prompt)[0] is a
+    # capable int8 TIES device fp: the earlier replica keeps the pick
+    a.direct_int8 = False
+    a.prefixes = {(8, d8): "device"}
+    b.prefixes, c.prefixes = {}, dict(row)
+    c.direct_int8 = True
+    assert router.plan_route(prompt)[0] is a
+    # ...and wins outright over host
+    a.prefixes = {(8, d8): "host"}
+    assert router.plan_route(prompt)[0] is c
+
+
+def test_engine_advertises_direct_capability(model_and_vars):
+    """kv_direct_int8 rides the /kvprefixes payload: True whenever the
+    mixed step would serve compressed hits in place (compression on,
+    any promote_hits except the legacy always-promote 1)."""
+    model, variables = model_and_vars
+    assert _engine(model, variables,
+                   kv_compress_blocks=24).kv_direct_int8 is True
+    assert _engine(model, variables, kv_compress_blocks=24,
+                   kv_promote_hits=2).kv_direct_int8 is True
+    assert _engine(model, variables, kv_compress_blocks=24,
+                   kv_promote_hits=1).kv_direct_int8 is False
+    assert _engine(model, variables).kv_direct_int8 is False

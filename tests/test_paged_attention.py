@@ -263,3 +263,142 @@ def test_env_override_dispatch(monkeypatch):
     monkeypatch.setenv("PTPU_PAGED_KERNEL", "bogus")
     with pytest.raises(ValueError, match="PTPU_PAGED_KERNEL"):
         ragged_paged_attention(*args)
+
+
+# -- mixed precision: int8-resident blocks read in place -------------------
+
+def _quantize_some_blocks(args, which="odd"):
+    """Move a deterministic subset of referenced fp blocks into int8
+    side pools and bias-encode their table entries (-slot-1). Returns
+    (mixed_args, promoted_args): the same batch expressed as a mixed
+    fp/int8 read and as the promote-then-step equivalent where each
+    quantized block is dequantized back into the fp pool — the ISSUE's
+    bar is that these two produce byte-identical output."""
+    from paddle_tpu.quant.int8_compute import dequantize_block, \
+        quantize_block
+    (qf, k_pool, v_pool, bt, cl, qs, tr, to) = args
+    bt = np.asarray(bt).copy()
+    nb = k_pool.shape[0]
+    # referenced (row, j) entries with full blocks only: quantizing a
+    # block that the row writes into would be invalid upstream, but at
+    # kernel level any referenced block is fair game — pick by parity.
+    picks = []
+    seen = set()
+    for i in range(bt.shape[0] - 1):
+        blocks = -(-int(cl[i]) // k_pool.shape[1])
+        for j in range(blocks):
+            b = int(bt[i, j])
+            if b in seen:
+                continue
+            seen.add(b)
+            if (which == "odd" and j % 2 == 1) or which == "all":
+                picks.append(b)
+    kq, vq, ksc, vsc = [], [], [], []
+    k_pro, v_pro = np.asarray(k_pool).copy(), np.asarray(v_pool).copy()
+    slot_of = {}
+    for b in picks:
+        q1, s1 = quantize_block(k_pool[b][None])
+        q2, s2 = quantize_block(v_pool[b][None])
+        slot_of[b] = len(kq)
+        kq.append(np.asarray(q1[0]))
+        ksc.append(float(s1[0]))
+        vq.append(np.asarray(q2[0]))
+        vsc.append(float(s2[0]))
+        k_pro[b] = np.asarray(dequantize_block(q1, s1, k_pool.dtype)[0])
+        v_pro[b] = np.asarray(dequantize_block(q2, s2, v_pool.dtype)[0])
+    if not picks:                     # degenerate: keep pools non-empty
+        kq.append(np.zeros(k_pool.shape[1:], np.int8))
+        vq.append(np.zeros(v_pool.shape[1:], np.int8))
+        ksc.append(1.0)
+        vsc.append(1.0)
+    bt_mixed = bt.copy()
+    for i in range(bt.shape[0]):
+        for j in range(bt.shape[1]):
+            b = int(bt[i, j])
+            if b in slot_of:
+                bt_mixed[i, j] = -(slot_of[b] + 1)
+    qkw = dict(kq_pool=jnp.asarray(np.stack(kq)),
+               vq_pool=jnp.asarray(np.stack(vq)),
+               k_scales=jnp.asarray(ksc, jnp.float32),
+               v_scales=jnp.asarray(vsc, jnp.float32))
+    mixed = ((qf, k_pool, v_pool, jnp.asarray(bt_mixed), cl, qs, tr, to),
+             qkw)
+    promoted = ((qf, jnp.asarray(k_pro), jnp.asarray(v_pro),
+                 jnp.asarray(bt), cl, qs, tr, to), qkw)
+    return mixed, promoted, len(picks)
+
+
+@pytest.mark.parametrize("rows,h,hkv,d,bs,tq", RAGGED_MIXED_CASES)
+def test_ragged_mixed_reference_bit_exact_vs_promote(rows, h, hkv, d,
+                                                     bs, tq):
+    """Direct int8 reads through the XLA reference == dequantize the
+    same blocks into the fp pool first, BYTE-identical: the in-kernel
+    dequant is the same f32 math as the promote path."""
+    args, *_ = _ragged_case(rows, h, hkv, d, bs, tq)
+    (margs, qkw), (pargs, _), n = _quantize_some_blocks(args)
+    got = ragged_paged_attention_reference(*margs, **qkw)
+    want = ragged_paged_attention_reference(*pargs)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("rows,h,hkv,d,bs,tq", RAGGED_MIXED_CASES)
+def test_ragged_mixed_kernel_bit_exact_vs_promote(rows, h, hkv, d, bs, tq):
+    """Same bar for the Pallas kernel (interpret mode): the mixed grid
+    must reproduce the promote-then-fp-step kernel output bit-for-bit."""
+    args, *_ = _ragged_case(rows, h, hkv, d, bs, tq)
+    (margs, qkw), (pargs, _), n = _quantize_some_blocks(args)
+    got = ragged_paged_attention(*margs, use_kernel=True, interpret=True,
+                                 **qkw)
+    want = ragged_paged_attention(*pargs, use_kernel=True, interpret=True)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("which", ["odd", "all"])
+def test_ragged_mixed_kernel_matches_reference(which):
+    """Mixed kernel vs mixed reference at the usual numeric bar,
+    including the all-int8 extreme."""
+    rows = [(9, 9), (13, 5), (6, 1)]
+    args, *_ = _ragged_case(rows, 4, 4, 8, 4, 4)
+    (margs, qkw), _, n = _quantize_some_blocks(args, which=which)
+    assert n > 0
+    got = ragged_paged_attention(*margs, use_kernel=True, interpret=True,
+                                 **qkw)
+    want = ragged_paged_attention_reference(*margs, **qkw)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+
+def test_ragged_fp_only_through_mixed_signature_bit_exact():
+    """A batch with NO negative table entries through the mixed
+    signature == the fp-only path, bit-for-bit, in both tiers — the
+    engine always passes qpools once compression is on, so fp-only
+    batches must not pay a numeric (or recompile) cost."""
+    rows = [(7, 1), (10, 6), (4, 4)]
+    args, *_ = _ragged_case(rows, 4, 4, 8, 4, 4)
+    nb = args[1].shape[1:]
+    qkw = dict(kq_pool=jnp.zeros((2,) + nb, jnp.int8),
+               vq_pool=jnp.zeros((2,) + nb, jnp.int8),
+               k_scales=jnp.ones((2,), jnp.float32),
+               v_scales=jnp.ones((2,), jnp.float32))
+    ref_fp = ragged_paged_attention_reference(*args)
+    ref_mx = ragged_paged_attention_reference(*args, **qkw)
+    assert np.array_equal(np.asarray(ref_fp), np.asarray(ref_mx))
+    ker_fp = ragged_paged_attention(*args, use_kernel=True, interpret=True)
+    ker_mx = ragged_paged_attention(*args, use_kernel=True, interpret=True,
+                                    **qkw)
+    assert np.array_equal(np.asarray(ker_fp), np.asarray(ker_mx))
+
+
+def test_env_override_dispatch_covers_mixed(monkeypatch):
+    """PTPU_PAGED_KERNEL steers the mixed path through the same three
+    tiers as the fp-only path."""
+    rows = [(9, 9), (6, 1)]
+    args, *_ = _ragged_case(rows, 4, 4, 8, 4, 4)
+    (margs, qkw), _, n = _quantize_some_blocks(args)
+    assert n > 0
+    ref = ragged_paged_attention_reference(*margs, **qkw)
+    monkeypatch.setenv("PTPU_PAGED_KERNEL", "interpret")
+    got = ragged_paged_attention(*margs, **qkw)
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+    monkeypatch.setenv("PTPU_PAGED_KERNEL", "reference")
+    got = ragged_paged_attention(*margs, **qkw)
+    assert np.array_equal(np.asarray(got), np.asarray(ref))

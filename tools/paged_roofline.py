@@ -31,12 +31,15 @@ is unchanged when the flag is absent.
 cold prefix blocks at half the fp bytes (+4 B of scales per block per
 plane, negligible), so warm-prefix capacity grows to (NB + C) * BS
 tokens for C * BS * Hkv * Dh bytes/layer of extra HBM (the `qpool_gb`
-column). The `KB/t_mix` column is the streamed-bytes model at full
-mixed residency r = C / (NB + C): promoted blocks read as fp today, so
-this column models a kernel that reads int8-resident blocks in place
-(half bytes for the compressed fraction) — an optimistic bound on what
-direct-int8 decode could recover, not the shipped read path. Output is
-unchanged when the flag is absent.
+column). The `KB/t_mix` column is the streamed-bytes account at mixed
+residency r = C / (NB + C): the SHIPPED ragged step reads int8-resident
+blocks in place (bias-encoded block-table ids steer each block's DMA to
+the fp or the int8 pool; per-block scales ride scalar prefetch), so the
+compressed fraction streams half the bytes. `--direct-int8` exercises
+that path: the CPU smoke runs the mixed kernel on a half-quantized pool
+(parity vs the XLA reference AND bit-identity vs dequantize-then-read),
+and `--rig` times the mixed kernel at the cell's residency instead of
+the fp-only kernel. Output is unchanged when the flags are absent.
 
 `--tp-size N` models tensor-parallel serving (engine `tp_size` knob):
 the KV pool is sharded over kv-heads, so the per-chip pool and the
@@ -122,8 +125,61 @@ def _ragged_decode_operands(batch, ctx, block_size, num_blocks, heads,
             jnp.asarray(qs), jnp.asarray(tr), jnp.asarray(to))
 
 
-def smoke_interpret():
-    """Tiny end-to-end validation: interpret-mode kernel vs reference."""
+def _quantize_operand_blocks(ops, int8_frac, seed=1):
+    """Move ~int8_frac of each row's referenced blocks into an int8
+    side pool, bias-encoding their table entries (-slot-1). Returns
+    (mixed_ops, qpool_kwargs, promoted_ops, n_int8, n_total):
+    promoted_ops is the same batch with the quantized blocks
+    dequantized back into the fp pool — the direct-read output must be
+    byte-identical to reading THAT (the promote path)."""
+    from paddle_tpu.quant.int8_compute import dequantize_block, \
+        quantize_block
+
+    (q, k_pool, v_pool, bt, cl, qs, tr, to) = ops
+    bt = np.asarray(bt).copy()
+    stride = max(1, round(1.0 / max(int8_frac, 1e-9)))
+    kq, vq, ksc, vsc = [], [], [], []
+    k_pro = np.asarray(k_pool).copy()
+    v_pro = np.asarray(v_pool).copy()
+    bt_mixed = bt.copy()
+    n_total = 0
+    rows = bt.shape[0] - 1                      # last row is the null row
+    for i in range(rows):
+        blocks = -(-int(cl[i]) // k_pool.shape[1])
+        n_total += blocks
+        for j in range(blocks):
+            if j % stride != stride - 1:
+                continue
+            b = int(bt[i, j])
+            q1, s1 = quantize_block(k_pool[b][None])
+            q2, s2 = quantize_block(v_pool[b][None])
+            bt_mixed[i, j] = -(len(kq) + 1)
+            kq.append(np.asarray(q1[0]))
+            ksc.append(float(s1[0]))
+            vq.append(np.asarray(q2[0]))
+            vsc.append(float(s2[0]))
+            k_pro[b] = np.asarray(dequantize_block(q1, s1, k_pool.dtype)[0])
+            v_pro[b] = np.asarray(dequantize_block(q2, s2, v_pool.dtype)[0])
+    if not kq:                                  # keep the pools non-empty
+        kq.append(np.zeros(k_pool.shape[1:], np.int8))
+        vq.append(np.zeros(v_pool.shape[1:], np.int8))
+        ksc.append(1.0)
+        vsc.append(1.0)
+    qkw = dict(kq_pool=jnp.asarray(np.stack(kq)),
+               vq_pool=jnp.asarray(np.stack(vq)),
+               k_scales=jnp.asarray(ksc, jnp.float32),
+               v_scales=jnp.asarray(vsc, jnp.float32))
+    mixed = (q, k_pool, v_pool, jnp.asarray(bt_mixed), cl, qs, tr, to)
+    promoted = (q, jnp.asarray(k_pro), jnp.asarray(v_pro),
+                jnp.asarray(bt), cl, qs, tr, to)
+    return mixed, qkw, promoted, len(kq), n_total
+
+
+def smoke_interpret(direct_int8=False):
+    """Tiny end-to-end validation: interpret-mode kernel vs reference;
+    with direct_int8 also the mixed-precision path on a half-quantized
+    pool, including bit-identity vs the promote (dequantize-first)
+    read."""
     from paddle_tpu.kernels import paged_attention as paged
 
     ops = _ragged_decode_operands(batch=2, ctx=10, block_size=4,
@@ -136,21 +192,42 @@ def smoke_interpret():
     ok = bool(np.isfinite(diff) and diff < 1e-5)
     print(f"interpret smoke: kernel vs reference max|diff| = {diff:.2e} "
           f"-> {'OK' if ok else 'FAIL'}")
-    return ok
+    if not direct_int8:
+        return ok
+    mixed, qkw, promoted, n8, nt = _quantize_operand_blocks(ops, 0.5)
+    mref = paged.ragged_paged_attention_reference(*mixed, **qkw)
+    mout = paged.ragged_paged_attention(*mixed, use_kernel=True,
+                                        interpret=True, **qkw)
+    mdiff = float(jnp.max(jnp.abs(mout - mref)))
+    pout = paged.ragged_paged_attention(*promoted, use_kernel=True,
+                                        interpret=True)
+    exact = bool(np.array_equal(np.asarray(mout), np.asarray(pout)))
+    mok = bool(np.isfinite(mdiff) and mdiff < 1e-5 and exact)
+    print(f"direct-int8 smoke: {n8}/{nt} blocks int8; mixed kernel vs "
+          f"reference max|diff| = {mdiff:.2e}; bit-identical to the "
+          f"promote read: {exact} -> {'OK' if mok else 'FAIL'}")
+    return ok and mok
 
 
 def measure_cell(batch, ctx, block_size, num_blocks, heads, kv_heads,
-                 head_dim, tile_q=8):
-    """Time one ragged decode launch on the rig; returns (ms, GB/s)."""
+                 head_dim, tile_q=8, int8_frac=0.0):
+    """Time one ragged decode launch on the rig; returns (ms, GB/s).
+    int8_frac > 0 times the MIXED kernel with that fraction of each
+    row's blocks int8-resident (the shipped direct-read path); the
+    streamed-bytes account prices those blocks at 1 B/elem."""
     from paddle_tpu.benchmark.harness import run_timed
     from paddle_tpu.kernels import paged_attention as paged
 
     ops = _ragged_decode_operands(batch, ctx, block_size, num_blocks,
                                   heads, kv_heads, head_dim, tile_q)
+    qkw, n8, nt = {}, 0, batch * -(-ctx // block_size)
+    if int8_frac > 0.0:
+        ops, qkw, _, n8, nt = _quantize_operand_blocks(ops, int8_frac)
     q = ops[0]
 
     def step(c):
-        out = paged.ragged_paged_attention(q + c.astype(q.dtype), *ops[1:])
+        out = paged.ragged_paged_attention(q + c.astype(q.dtype),
+                                           *ops[1:], **qkw)
         return (jnp.sum(out.astype(jnp.float32)) * 1e-30
                 ).astype(jnp.float32)
 
@@ -161,10 +238,15 @@ def measure_cell(batch, ctx, block_size, num_blocks, heads, kv_heads,
         return out, out
 
     sec, _, _ = run_timed(once, jnp.zeros((), jnp.float32), min_time=1.0)
-    # one attention layer's streamed bytes (fp32 operands here: 4B)
+    # one attention layer's streamed bytes (fp32 operands here: 4B;
+    # int8-resident blocks stream 1B + a 4B scale per block per plane)
     streamed = batch * decode_bytes_per_token(1, ctx, block_size,
                                               kv_heads, head_dim,
                                               dtype_bytes=4)
+    if n8:
+        blk = 2 * block_size * kv_heads * head_dim
+        streamed -= n8 * blk * 3            # 4B -> 1B on the int8 share
+        streamed += n8 * 2 * 4              # per-plane scales
     return sec * 1e3, streamed / sec / 1e9
 
 
@@ -193,6 +275,12 @@ def main():
                     help="model the device int8 compressed tier: "
                     "effective-pool and mixed-residency streamed-bytes "
                     "columns for a C-block int8 side pool")
+    ap.add_argument("--direct-int8", action="store_true",
+                    help="exercise the shipped direct-read mixed step: "
+                    "the CPU smoke validates the mixed kernel (parity "
+                    "vs reference, bit-identity vs promote-then-read); "
+                    "--rig times the mixed kernel at each cell's "
+                    "residency r = C/(NB+C) instead of the fp kernel")
     ap.add_argument("--tp-size", type=int, default=1,
                     help="model tensor-parallel serving: per-chip "
                     "pool/bytes columns (/N) plus the decode-MLP "
@@ -236,10 +324,16 @@ def main():
     cb = args.compress_blocks
     if cb < 0:
         raise SystemExit(f"--compress-blocks {cb} must be >= 0")
+    if args.direct_int8 and not cb:
+        raise SystemExit("--direct-int8 needs --compress-blocks > 0 "
+                         "(it prices the mixed-residency column)")
     if cb:
         print(f"compress: {cb}-block int8 side pool; eff_tok counts "
-              f"warm-prefix capacity, KB/t_mix models direct int8 "
-              f"reads at full residency (optimistic bound)")
+              f"warm-prefix capacity, KB/t_mix prices the shipped "
+              f"direct-read step at residency r = C/(NB+C) "
+              f"(int8-resident blocks stream half bytes in place"
+              + (", measured on the mixed kernel"
+                 if args.direct_int8 and args.rig else "") + ")")
     hdr = (f"{'BS':>4} {'NB':>6} {'pool_gb':>8} {'%hbm':>6} "
            f"{'cap_tok':>8} {'ctx/row':>8} {'KB/tok':>8} "
            f"{'tok_s_ceil':>10}")
@@ -291,14 +385,16 @@ def main():
                 print(line)
                 continue
             if args.rig:
+                frac8 = (cb / (nb + cb)) if args.direct_int8 else 0.0
                 ms, gbs = measure_cell(args.batch, ctx, bs, nb,
-                                       args.heads, Hkv, Dh)
+                                       args.heads, Hkv, Dh,
+                                       int8_frac=frac8)
                 line += (f" {ms:>8.3f} {gbs:>7.1f} "
                          f"{gbs/args.hbm_gbps*100:>4.1f}%")
             print(line)
 
     if not args.rig:
-        ok = smoke_interpret()
+        ok = smoke_interpret(direct_int8=args.direct_int8)
     return 0 if ok else 1
 
 

@@ -822,10 +822,14 @@ def _run_compress_cell(model, variables, args, prompts, budget):
     evicted system prefix promotes back from int8 instead of
     re-prefilling. Emitted AS MEASURED (the early-flush contract)."""
     tag = "_on" if budget else "_off"
+    # kv_promote_hits=1 pins the legacy always-promote ladder this
+    # scenario gates on (promote_total > 0); the direct-read default is
+    # exercised by scenario_direct_read
     eng = make_engine(model, variables, args, block_size=4,
                       num_blocks=args.compress_num_blocks,
                       max_prefill_tokens=64,
-                      kv_compress_blocks=budget)
+                      kv_compress_blocks=budget,
+                      kv_promote_hits=1 if budget else 0)
     eng.generate([[args.vocab - 1] * len(prompts[0])],
                  max_new_tokens=2)                  # compile untimed
     eng.reset_stats()
@@ -914,6 +918,103 @@ def scenario_compress(model, variables, args):
           "promote_total": on["stats"]["promote_total"],
           "on_identical_to_roomy":
               bool(on["outs"] == ref_outs)})       # informational only
+    return ok
+
+
+# -- scenario: mixed-precision direct int8 reads vs the promote ladder -----
+
+def _run_direct_cell(model, variables, args, prompts, fillers,
+                     promote_hits):
+    """cold -> churn (evicts the fp copies, int8 copies survive) ->
+    warm, on one engine. promote_hits=1 is the legacy always-promote
+    ladder; 0 serves the warm hits in place through the mixed step.
+    Emitted AS MEASURED (the early-flush contract)."""
+    tag = "_direct" if promote_hits == 0 else "_promote"
+    # slot budget sized so the filler churn's own compressed blocks
+    # never LRU-spill the system prefix out of the int8 tier (fp hits
+    # don't refresh _cindex recency, so the system keys age from their
+    # compression time) — the scenario measures the read path, not
+    # slot-pool pressure
+    eng = make_engine(model, variables, args, block_size=4,
+                      num_blocks=args.direct_num_blocks,
+                      max_prefill_tokens=64,
+                      kv_compress_blocks=max(
+                          256, 4 * args.compress_budget_blocks),
+                      kv_promote_hits=promote_hits)
+    eng.generate([[args.vocab - 1] * len(prompts[0])],
+                 max_new_tokens=2)                  # compile untimed
+    eng.reset_stats()
+    cold_outs, _, cold_wall = _serve_turns_ttft(
+        eng, prompts, args.compress_new_tokens)
+    for f in fillers:                               # churn fp copies out
+        eng.add_request(f, max_new_tokens=args.compress_new_tokens)
+        eng.run()
+    warm_outs, warm_ttfts, warm_wall = _serve_turns_ttft(
+        eng, prompts, args.compress_new_tokens)
+    st = eng.cache.stats()
+    eng.cache.assert_quiesced()
+    cell = {"cell": f"direct{tag}", "requests": len(prompts),
+            "promote_hits": promote_hits,
+            "cold_wall_s": round(cold_wall, 3),
+            "warm_wall_s": round(warm_wall, 3),
+            "warm_mean_ttft_ms": round(float(np.mean(warm_ttfts)), 3),
+            "promote_total": st.get("promote_total", 0),
+            "direct_int8_reads": st.get("direct_int8_reads", 0),
+            "direct_int8_tokens": st.get("direct_int8_tokens", 0),
+            "compiles": int(eng._step_fn._cache_size())}
+    emit(cell)
+    return {"eng": eng, "cold": cold_outs, "warm": warm_outs,
+            "ttft": float(np.mean(warm_ttfts)), "stats": st,
+            "compiles": int(eng._step_fn._cache_size())}
+
+
+def scenario_direct_read(model, variables, args):
+    """A/B the mixed step's direct int8 reads against the legacy
+    always-promote ladder on identical traffic. Gates: the direct cell
+    is BYTE-identical to the promote cell (cold and warm), its promote
+    counter stays at 0 while its direct-read counter moves, its warm
+    TTFT does not regress past the promote cell's (1.25x slack: both
+    cells run jitted CPU steps where the dequant cost is noise), and
+    both cells hold the one-compilation invariant. Prompt tails sit off
+    block stride so no warm hit is a full-prompt final-block hit
+    (those force-promote by design — the last token's write needs a
+    writable fp block)."""
+    global LAST_EXPOSITION, LAST_TRACER
+    rng = np.random.default_rng(9)
+    system = rng.integers(0, args.vocab - 1,
+                          args.compress_system_len).tolist()
+    tail = max(1, args.compress_tail_len)
+    if (args.compress_system_len + tail) % 4 == 0:
+        tail += 1                                   # stay off stride
+    prompts = [system + rng.integers(0, args.vocab - 1, tail).tolist()
+               for _ in range(args.compress_requests)]
+    fillers = [rng.integers(0, args.vocab - 1, 33).tolist()
+               for _ in range(8)]
+
+    pro = _run_direct_cell(model, variables, args, prompts, fillers,
+                           promote_hits=1)
+    dct = _run_direct_cell(model, variables, args, prompts, fillers,
+                           promote_hits=0)
+    LAST_EXPOSITION = dct["eng"].metrics_text()
+    LAST_TRACER = dct["eng"].tracer
+
+    identical = bool(dct["cold"] == pro["cold"]
+                     and dct["warm"] == pro["warm"])
+    ok = bool(identical
+              and dct["stats"]["promote_total"] == 0
+              and dct["stats"]["direct_int8_reads"] > 0
+              and pro["stats"]["promote_total"] > 0
+              and pro["stats"]["direct_int8_reads"] == 0
+              and dct["ttft"] <= pro["ttft"] * 1.25
+              and pro["compiles"] == 1 and dct["compiles"] == 1)
+    emit({"cell": "direct_read_verdict", "ok": ok,
+          "identical_to_promote_path": identical,
+          "promote_total_direct": dct["stats"]["promote_total"],
+          "promote_total_promote": pro["stats"]["promote_total"],
+          "direct_int8_reads": dct["stats"]["direct_int8_reads"],
+          "direct_int8_tokens": dct["stats"]["direct_int8_tokens"],
+          "warm_ttft_direct_ms": round(dct["ttft"], 3),
+          "warm_ttft_promote_ms": round(pro["ttft"], 3)})
     return ok
 
 
@@ -2202,7 +2303,7 @@ def main():
     ap.add_argument("--scenario", default="all",
                     choices=["all", "batch", "prefix", "chunked",
                              "mixed", "spec", "nbest", "tiered",
-                             "compress", "tp",
+                             "compress", "direct_read", "tp",
                              "router", "fleet_chaos", "disagg",
                              "soak", "fleet_admission"])
     ap.add_argument("--requests", type=int, default=8)
@@ -2232,6 +2333,11 @@ def main():
     ap.add_argument("--compress-num-blocks", type=int, default=16,
                     help="block pool size for the compress scenario — "
                     "small enough that the concurrent burst preempts "
+                    "(block_size is pinned to 4 in this scenario)")
+    ap.add_argument("--direct-num-blocks", type=int, default=24,
+                    help="block pool size for the direct_read scenario "
+                    "— roomy enough that turns never preempt, small "
+                    "enough that the filler churn evicts the fp copies "
                     "(block_size is pinned to 4 in this scenario)")
     ap.add_argument("--compress-budget-blocks", type=int, default=48,
                     help="kv_compress_blocks for the compression-on "
@@ -2284,7 +2390,9 @@ def main():
                  "chunked": scenario_chunked, "mixed": scenario_mixed,
                  "spec": scenario_spec, "nbest": scenario_nbest,
                  "tiered": scenario_tiered,
-                 "compress": scenario_compress, "tp": scenario_tp,
+                 "compress": scenario_compress,
+                 "direct_read": scenario_direct_read,
+                 "tp": scenario_tp,
                  "router": scenario_router,
                  "fleet_chaos": scenario_fleet_chaos,
                  "disagg": scenario_disagg,
